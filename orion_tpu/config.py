@@ -1,0 +1,311 @@
+"""Configuration dataclasses for every subsystem.
+
+Plain dataclasses + a tiny yaml/flag loader (SURVEY.md §5 "Config/flag
+system"): per-algorithm configs subclass a common ``TrainConfig`` the way
+the reference's PPO/DPO/RLOO/GRPO configs share a common trainer config.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+
+# ---------------------------------------------------------------------------
+# Model
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class ModelConfig:
+    """Architecture hyperparameters for the decoder-only transformer.
+
+    One configurable implementation covers both model families the spec
+    requires (SURVEY.md §2 #14): ``arch="llama"`` (RMSNorm, SwiGLU, full
+    rotary, GQA — Llama-3-8B) and ``arch="neox"`` (LayerNorm, parallel
+    attention+MLP residual, partial rotary — Pythia-1B).
+    """
+
+    arch: str = "llama"  # "llama" | "neox"
+    vocab_size: int = 32000
+    hidden_size: int = 512
+    intermediate_size: int = 1376
+    num_layers: int = 4
+    num_heads: int = 8
+    num_kv_heads: int = 8  # < num_heads => GQA (llama only)
+    head_dim: int = 0  # 0 => hidden_size // num_heads
+    max_seq_len: int = 2048
+    rope_theta: float = 10000.0
+    rotary_pct: float = 1.0  # neox uses 0.25
+    rms_norm_eps: float = 1e-5
+    layernorm_eps: float = 1e-5
+    tie_word_embeddings: bool = False
+    use_parallel_residual: bool = False  # neox style
+    attn_bias: bool = False  # neox uses biases everywhere
+    mlp_bias: bool = False
+    dtype: str = "bfloat16"  # compute dtype
+    param_dtype: str = "float32"  # master weights
+    remat: bool = False  # jax.checkpoint each block (HBM <-> FLOPs trade)
+    attention_impl: str = "auto"  # "auto" | "reference" | "flash" | "ring"
+    scan_layers: bool = False  # lax.scan over stacked layers (compile-time win)
+
+    def __post_init__(self) -> None:
+        if self.head_dim == 0:
+            self.head_dim = self.hidden_size // self.num_heads
+        if self.arch == "neox":
+            # GPT-NeoX has no GQA.  (use_parallel_residual stays as
+            # given — NeoX-family checkpoints exist with either value.)
+            self.num_kv_heads = self.num_heads
+
+    @staticmethod
+    def llama3_8b() -> "ModelConfig":
+        return ModelConfig(
+            arch="llama", vocab_size=128256, hidden_size=4096,
+            intermediate_size=14336, num_layers=32, num_heads=32,
+            num_kv_heads=8, max_seq_len=8192, rope_theta=500000.0,
+        )
+
+    @staticmethod
+    def llama3_1b() -> "ModelConfig":
+        # Llama-3.2-1B shape — the "1B reward model" scale of SPEC config 2.
+        return ModelConfig(
+            arch="llama", vocab_size=128256, hidden_size=2048,
+            intermediate_size=8192, num_layers=16, num_heads=32,
+            num_kv_heads=8, max_seq_len=8192, rope_theta=500000.0,
+        )
+
+    @staticmethod
+    def pythia_1b() -> "ModelConfig":
+        return ModelConfig(
+            arch="neox", vocab_size=50304, hidden_size=2048,
+            intermediate_size=8192, num_layers=16, num_heads=8,
+            rotary_pct=0.25, use_parallel_residual=True,
+            attn_bias=True, mlp_bias=True, layernorm_eps=1e-5,
+            tie_word_embeddings=False,
+        )
+
+    @staticmethod
+    def tiny(arch: str = "llama", **kw: Any) -> "ModelConfig":
+        """Small config for tests (runs on CPU in <1s)."""
+        base = dict(
+            arch=arch, vocab_size=256, hidden_size=64,
+            intermediate_size=128, num_layers=2, num_heads=4,
+            num_kv_heads=2 if arch == "llama" else 4, max_seq_len=128,
+        )
+        base.update(kw)
+        return ModelConfig(**base)
+
+
+# ---------------------------------------------------------------------------
+# Mesh / parallelism
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class MeshConfig:
+    """Logical device mesh over which everything is sharded.
+
+    Axes (SURVEY.md §2 parallelism table):
+      data   — pure data parallelism (gradient all-reduce)
+      fsdp   — ZeRO-3-style parameter/grad sharding (AG on use, RS on grads)
+      tensor — megatron-style tensor parallelism (heads/mlp/vocab)
+      seq    — sequence/context parallelism (Ulysses all-to-all, ring attn)
+
+    A size of 1 disables an axis; sizes must multiply to the device count.
+    -1 for ``fsdp`` means "all remaining devices".
+    """
+
+    data: int = 1
+    fsdp: int = -1
+    tensor: int = 1
+    seq: int = 1
+    axis_names: tuple = ("data", "fsdp", "seq", "tensor")
+
+    def resolved_shape(self, n_devices: int) -> tuple:
+        sizes = {"data": self.data, "fsdp": self.fsdp,
+                 "seq": self.seq, "tensor": self.tensor}
+        fixed = 1
+        free = None
+        for name, s in sizes.items():
+            if s == -1:
+                if free is not None:
+                    raise ValueError("only one mesh axis may be -1")
+                free = name
+            else:
+                fixed *= s
+        if free is not None:
+            if n_devices % fixed != 0:
+                raise ValueError(
+                    f"{n_devices} devices not divisible by fixed axes {fixed}")
+            sizes[free] = n_devices // fixed
+        total = 1
+        for s in sizes.values():
+            total *= s
+        if total != n_devices:
+            raise ValueError(
+                f"mesh {sizes} does not cover {n_devices} devices")
+        return tuple(sizes[n] for n in self.axis_names)
+
+
+# ---------------------------------------------------------------------------
+# Optimizer / rollout / train
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class OptimizerConfig:
+    name: str = "adamw"
+    learning_rate: float = 1e-6
+    betas: tuple = (0.9, 0.95)
+    eps: float = 1e-8
+    weight_decay: float = 0.0
+    grad_clip: float = 1.0
+    warmup_steps: int = 0
+    total_steps: int = 0  # 0 => constant lr after warmup
+    schedule: str = "constant"  # "constant" | "linear" | "cosine"
+
+
+@dataclass
+class RolloutConfig:
+    """Generation engine settings (the vLLM-equivalent, SURVEY.md §2 #5)."""
+
+    max_prompt_len: int = 512
+    max_new_tokens: int = 512
+    temperature: float = 1.0
+    top_k: int = 0  # 0 => disabled
+    top_p: float = 1.0  # 1.0 => disabled
+    # Paged KV cache: capacity in pages; page_size tokens per page.
+    paged: bool = False
+    page_size: int = 64
+    num_pages: int = 0  # 0 => derived from batch * max_len
+    # Continuous batching: max sequences admitted per engine segment.
+    max_batch_size: int = 32
+    logprobs_dtype: str = "float32"  # f32 softmax to avoid bf16 drift
+
+
+@dataclass
+class TrainConfig:
+    """Common trainer settings shared by all algorithms."""
+
+    seed: int = 0
+    model: ModelConfig = field(default_factory=ModelConfig)
+    mesh: MeshConfig = field(default_factory=MeshConfig)
+    optimizer: OptimizerConfig = field(default_factory=OptimizerConfig)
+    rollout: RolloutConfig = field(default_factory=RolloutConfig)
+
+    total_iterations: int = 100
+    # Experience batch: prompts per iteration; optimization runs
+    # num_epochs passes of minibatches of size minibatch_size over it.
+    rollout_batch_size: int = 32
+    minibatch_size: int = 8
+    num_epochs: int = 1
+    # KL regularization against the frozen reference policy.
+    kl_coef: float = 0.05
+    adaptive_kl: bool = False
+    kl_target: float = 6.0
+    kl_horizon: int = 10000
+    # Whitening / reward shaping.
+    whiten_advantages: bool = True
+    reward_clip: float = 10.0
+    # Checkpointing / logging.
+    checkpoint_dir: Optional[str] = None
+    checkpoint_every: int = 0  # 0 => disabled
+    log_every: int = 1
+    # Async mode (SPEC config 4).
+    async_mode: bool = False
+    async_staleness: int = 1  # max steps rollout weights may lag
+    rollout_devices: int = 0  # devices reserved for rollout group (async)
+
+
+@dataclass
+class PPOConfig(TrainConfig):
+    clip_ratio: float = 0.2
+    value_clip: float = 0.2
+    vf_coef: float = 0.1
+    gamma: float = 1.0
+    gae_lambda: float = 0.95
+    num_epochs: int = 4
+
+
+@dataclass
+class GRPOConfig(TrainConfig):
+    group_size: int = 8  # completions per prompt
+    clip_ratio: float = 0.2
+    # DR-GRPO / GRPO variants: "grpo" normalizes by group std, "dr_grpo" skips.
+    variant: str = "grpo"
+
+
+@dataclass
+class RLOOConfig(TrainConfig):
+    group_size: int = 4  # k rollouts per prompt, leave-one-out baseline
+    # RLOO applies KL inside the reward (sequence-level) by default.
+    kl_in_reward: bool = True
+
+
+@dataclass
+class OnlineDPOConfig(TrainConfig):
+    beta: float = 0.1
+    group_size: int = 2  # sample a pair per prompt
+    label_smoothing: float = 0.0
+
+
+# ---------------------------------------------------------------------------
+# Loading helpers
+# ---------------------------------------------------------------------------
+
+
+def _apply_overrides(cfg: Any, overrides: dict) -> Any:
+    for key, value in overrides.items():
+        parts = key.split(".")
+        obj = cfg
+        for p in parts[:-1]:
+            obj = getattr(obj, p)
+        leaf = parts[-1]
+        if not hasattr(obj, leaf):
+            raise KeyError(f"unknown config key: {key}")
+        current = getattr(obj, leaf)
+        if current is not None and not dataclasses.is_dataclass(current):
+            if isinstance(current, bool) and isinstance(value, str):
+                value = value.lower() in ("1", "true", "yes")
+            elif isinstance(current, tuple) and isinstance(value, (list, tuple)):
+                value = tuple(value)
+            elif isinstance(current, tuple) and isinstance(value, str):
+                elem_type = type(current[0]) if current else float
+                value = tuple(elem_type(v) for v in value.split(","))
+            elif current is not None and isinstance(value, str):
+                value = type(current)(value)
+        setattr(obj, leaf, value)
+    return cfg
+
+
+def load_config(cls, yaml_path: Optional[str] = None,
+                cli_args: Optional[list] = None):
+    """Build a config from an optional yaml file plus ``key=value`` CLI args.
+
+    Nested keys use dots: ``model.hidden_size=1024 optimizer.learning_rate=3e-6``.
+    """
+    cfg = cls()
+    if yaml_path:
+        import yaml  # lazy: pyyaml ships with the base image
+
+        with open(yaml_path) as f:
+            data = yaml.safe_load(f) or {}
+
+        def flatten(d, prefix=""):
+            out = {}
+            for k, v in d.items():
+                kk = f"{prefix}{k}"
+                if isinstance(v, dict):
+                    out.update(flatten(v, kk + "."))
+                else:
+                    out[kk] = v
+            return out
+
+        _apply_overrides(cfg, flatten(data))
+    for arg in cli_args or []:
+        if "=" not in arg:
+            raise ValueError(f"expected key=value, got {arg!r}")
+        k, v = arg.split("=", 1)
+        _apply_overrides(cfg, {k: v})
+    return cfg

@@ -1,0 +1,12 @@
+from orion_tpu.models.transformer import (  # noqa: F401
+    Transformer,
+    init_cache,
+    init_params,
+    logical_specs,
+)
+from orion_tpu.models.heads import (  # noqa: F401
+    ScalarHeadModel,
+    score_last_token,
+    init_scalar_params,
+)
+from orion_tpu.models.sharded import make_sharded_model  # noqa: F401

@@ -1,0 +1,51 @@
+"""Scalar heads: critic value model and reward model (SURVEY.md §2 #6-7).
+
+Both are the backbone plus a Dense(1) head over final-norm hidden
+states.  The critic reads per-token values over the response; the reward
+model reads the value at the last real token of each sequence.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from orion_tpu.config import ModelConfig
+from orion_tpu.models.transformer import Transformer, _dense, _dt
+
+
+class ScalarHeadModel(nn.Module):
+    """Backbone + scalar head → per-position values [B, L] (f32)."""
+
+    cfg: ModelConfig
+
+    @nn.compact
+    def __call__(self, input_ids, positions):
+        _, _, hidden = Transformer(self.cfg, name="backbone")(
+            input_ids, positions, return_hidden=True, skip_lm_head=True)
+        head = nn.Dense(
+            features=1, use_bias=False, dtype=_dt(self.cfg.dtype),
+            param_dtype=_dt(self.cfg.param_dtype),
+            kernel_init=nn.with_logical_partitioning(
+                nn.initializers.normal(stddev=1.0 / self.cfg.hidden_size ** 0.5),
+                ("embed", "norm")),
+            name="score_head")
+        values = head(hidden)[..., 0]
+        return values.astype(jnp.float32)
+
+
+def score_last_token(values: jnp.ndarray, lengths: jnp.ndarray) -> jnp.ndarray:
+    """Gather values at the last real token: values [B, L], lengths [B]."""
+    idx = jnp.clip(lengths - 1, 0, values.shape[1] - 1)
+    return jnp.take_along_axis(values, idx[:, None], axis=1)[:, 0]
+
+
+def init_scalar_params(model: ScalarHeadModel, rng: jax.Array,
+                       unbox: bool = True):
+    ids = jnp.zeros((1, 2), jnp.int32)
+    variables = model.init(rng, ids, ids)
+    params = variables["params"]
+    return nn.meta.unbox(params) if unbox else params

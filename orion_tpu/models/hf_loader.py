@@ -1,0 +1,181 @@
+"""HF checkpoint → JAX param-tree conversion (SURVEY.md §2 #14).
+
+Two entry points:
+- ``convert_hf_state_dict(state_dict, cfg)`` — takes an in-memory
+  mapping of HF parameter names to numpy/torch tensors (used by the
+  parity tests, which build tiny HF torch models directly).
+- ``load_hf_pretrained(path, cfg)`` — streams ``*.safetensors`` files
+  from a local HF checkpoint directory (zero-egress box: weights must
+  already be on disk).
+
+torch is CPU-only in this image and used solely here, for tensor
+deserialization — it never touches the compute path.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+from typing import Any, Dict, Mapping
+
+import numpy as np
+
+from orion_tpu.config import ModelConfig
+
+
+def _np(t: Any) -> np.ndarray:
+    """To numpy, upcasting sub-f32 floats (bf16/f16 checkpoints) to f32
+    so the f32-master-weights contract holds regardless of source dtype."""
+    if not isinstance(t, np.ndarray):
+        import torch
+
+        if t.dtype == torch.bfloat16:
+            t = t.float()
+        t = t.detach().cpu().numpy()
+    # ml_dtypes.bfloat16 (safetensors framework="np") registers as a
+    # custom numpy dtype; detect by name.
+    if t.dtype.name in ("bfloat16", "float16"):
+        t = t.astype(np.float32)
+    return t
+
+
+def _lin(w: Any, bias: Any = None) -> Dict[str, np.ndarray]:
+    out = {"kernel": _np(w).T.copy()}
+    if bias is not None:
+        out["bias"] = _np(bias)
+    return out
+
+
+def convert_hf_state_dict(sd: Mapping[str, Any], cfg: ModelConfig) -> dict:
+    if cfg.arch == "llama":
+        return _convert_llama(sd, cfg)
+    if cfg.arch == "neox":
+        return _convert_neox(sd, cfg)
+    raise ValueError(cfg.arch)
+
+
+def _convert_llama(sd: Mapping[str, Any], cfg: ModelConfig) -> dict:
+    p: dict = {"embed": {"embedding": _np(sd["model.embed_tokens.weight"])}}
+    for i in range(cfg.num_layers):
+        pre = f"model.layers.{i}."
+        p[f"layers_{i}"] = {
+            "attn": {
+                "q_proj": _lin(sd[pre + "self_attn.q_proj.weight"]),
+                "k_proj": _lin(sd[pre + "self_attn.k_proj.weight"]),
+                "v_proj": _lin(sd[pre + "self_attn.v_proj.weight"]),
+                "o_proj": _lin(sd[pre + "self_attn.o_proj.weight"]),
+            },
+            "mlp": {
+                "gate_proj": _lin(sd[pre + "mlp.gate_proj.weight"]),
+                "up_proj": _lin(sd[pre + "mlp.up_proj.weight"]),
+                "down_proj": _lin(sd[pre + "mlp.down_proj.weight"]),
+            },
+            "input_norm": {"scale": _np(sd[pre + "input_layernorm.weight"])},
+            "post_attn_norm": {
+                "scale": _np(sd[pre + "post_attention_layernorm.weight"])},
+        }
+    p["final_norm"] = {"scale": _np(sd["model.norm.weight"])}
+    if not cfg.tie_word_embeddings:
+        key = "lm_head.weight"
+        if key not in sd:  # tied checkpoints omit it
+            key = "model.embed_tokens.weight"
+        p["lm_head"] = _lin(sd[key])
+    return p
+
+
+def _convert_neox(sd: Mapping[str, Any], cfg: ModelConfig) -> dict:
+    H, D, E = cfg.num_heads, cfg.head_dim, cfg.hidden_size
+    p: dict = {"embed": {"embedding": _np(sd["gpt_neox.embed_in.weight"])}}
+    for i in range(cfg.num_layers):
+        pre = f"gpt_neox.layers.{i}."
+        # HF GPT-NeoX fuses qkv head-major: weight [H*3*D, E] viewed as
+        # [H, 3, D, E]; split into per-head q/k/v then flatten back.
+        qkv_w = _np(sd[pre + "attention.query_key_value.weight"])
+        qkv_w = qkv_w.reshape(H, 3, D, E)
+        qkv_b = _np(sd[pre + "attention.query_key_value.bias"]).reshape(H, 3, D)
+
+        def proj(j):
+            w = qkv_w[:, j].reshape(H * D, E)
+            b = qkv_b[:, j].reshape(H * D)
+            return {"kernel": w.T.copy(), "bias": b}
+
+        p[f"layers_{i}"] = {
+            "attn": {
+                "q_proj": proj(0),
+                "k_proj": proj(1),
+                "v_proj": proj(2),
+                "o_proj": _lin(sd[pre + "attention.dense.weight"],
+                               sd[pre + "attention.dense.bias"]),
+            },
+            "mlp": {
+                "up_proj": _lin(sd[pre + "mlp.dense_h_to_4h.weight"],
+                                sd[pre + "mlp.dense_h_to_4h.bias"]),
+                "down_proj": _lin(sd[pre + "mlp.dense_4h_to_h.weight"],
+                                  sd[pre + "mlp.dense_4h_to_h.bias"]),
+            },
+            "input_norm": {
+                "scale": _np(sd[pre + "input_layernorm.weight"]),
+                "bias": _np(sd[pre + "input_layernorm.bias"]),
+            },
+            "post_attn_norm": {
+                "scale": _np(sd[pre + "post_attention_layernorm.weight"]),
+                "bias": _np(sd[pre + "post_attention_layernorm.bias"]),
+            },
+        }
+    p["final_norm"] = {
+        "scale": _np(sd["gpt_neox.final_layer_norm.weight"]),
+        "bias": _np(sd["gpt_neox.final_layer_norm.bias"]),
+    }
+    p["lm_head"] = _lin(sd["embed_out.weight"])
+    return p
+
+
+def load_hf_pretrained(path: str, cfg: ModelConfig) -> dict:
+    """Load a local HF safetensors checkpoint directory."""
+    from safetensors import safe_open
+
+    files = sorted(glob.glob(os.path.join(path, "*.safetensors")))
+    if not files:
+        raise FileNotFoundError(f"no safetensors under {path}")
+    sd: Dict[str, np.ndarray] = {}
+    for f in files:
+        with safe_open(f, framework="np") as st:
+            for k in st.keys():
+                sd[k] = st.get_tensor(k)
+    return convert_hf_state_dict(sd, cfg)
+
+
+def config_from_hf(hf_cfg: Any) -> ModelConfig:
+    """Build a ModelConfig from a transformers config object."""
+    mt = getattr(hf_cfg, "model_type", "")
+    if mt == "llama":
+        return ModelConfig(
+            arch="llama",
+            vocab_size=hf_cfg.vocab_size,
+            hidden_size=hf_cfg.hidden_size,
+            intermediate_size=hf_cfg.intermediate_size,
+            num_layers=hf_cfg.num_hidden_layers,
+            num_heads=hf_cfg.num_attention_heads,
+            num_kv_heads=hf_cfg.num_key_value_heads,
+            max_seq_len=hf_cfg.max_position_embeddings,
+            rope_theta=hf_cfg.rope_theta,
+            rms_norm_eps=hf_cfg.rms_norm_eps,
+            tie_word_embeddings=hf_cfg.tie_word_embeddings,
+        )
+    if mt == "gpt_neox":
+        return ModelConfig(
+            arch="neox",
+            vocab_size=hf_cfg.vocab_size,
+            hidden_size=hf_cfg.hidden_size,
+            intermediate_size=hf_cfg.intermediate_size,
+            num_layers=hf_cfg.num_hidden_layers,
+            num_heads=hf_cfg.num_attention_heads,
+            max_seq_len=hf_cfg.max_position_embeddings,
+            rope_theta=getattr(hf_cfg, "rotary_emb_base", 10000.0),
+            rotary_pct=hf_cfg.rotary_pct,
+            layernorm_eps=hf_cfg.layer_norm_eps,
+            use_parallel_residual=hf_cfg.use_parallel_residual,
+            attn_bias=True, mlp_bias=True,
+            tie_word_embeddings=hf_cfg.tie_word_embeddings,
+        )
+    raise ValueError(f"unsupported HF model_type: {mt}")

@@ -1,0 +1,51 @@
+"""Glue: model definition → sharded params on a mesh.
+
+``make_sharded_model`` initializes (or receives) a param tree and places
+it on the mesh according to the logical-axis annotations — the moment
+where the FSDP/TP layout (SURVEY.md §2 #9) is fixed.  After this, every
+jitted function touching the params inherits the layout and XLA inserts
+the all-gather/reduce-scatter collectives.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+from jax.sharding import Mesh
+
+from orion_tpu.parallel.sharding import LOGICAL_RULES
+
+
+def _rules_list():
+    return [(k, v) for k, v in LOGICAL_RULES.items()]
+
+
+def mesh_shardings_for(model: nn.Module, mesh: Mesh, init_args: tuple):
+    """Pytree of NamedShardings for the model's params."""
+    variables = jax.eval_shape(model.init, jax.random.key(0), *init_args)
+    logical = nn.get_partition_spec(variables)["params"]
+    return nn.logical_to_mesh_sharding(logical, mesh, _rules_list())
+
+
+def make_sharded_model(model: nn.Module, mesh: Mesh, rng: jax.Array,
+                       init_args: tuple,
+                       host_params: Optional[Any] = None):
+    """Returns (params_on_mesh, shardings).
+
+    If ``host_params`` is given (e.g. converted HF weights) they are
+    device_put with the computed shardings; otherwise params are
+    initialized *directly sharded* via jit(out_shardings=...) so even
+    8B-scale init never materializes unsharded.
+    """
+    shardings = mesh_shardings_for(model, mesh, init_args)
+    if host_params is not None:
+        params = jax.device_put(host_params, shardings)
+        return params, shardings
+
+    def init_fn(rng):
+        return nn.meta.unbox(model.init(rng, *init_args)["params"])
+
+    params = jax.jit(init_fn, out_shardings=shardings)(rng)
+    return params, shardings

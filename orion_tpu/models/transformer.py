@@ -1,0 +1,254 @@
+"""The decoder-only transformer (policy / reference / critic / RM backbone).
+
+One configurable implementation covers the two model families the spec
+requires (SURVEY.md §2 #14):
+
+- ``arch="llama"``: RMSNorm, SwiGLU MLP, full rotary, optional GQA
+  (Llama-3 family).
+- ``arch="neox"``: LayerNorm with bias, parallel attention+MLP residual,
+  partial rotary (``rotary_pct``), biased projections (Pythia family).
+
+Design notes (TPU-first):
+- Params are annotated with *logical* axes via flax logical
+  partitioning; the mesh rules in ``orion_tpu.parallel.sharding`` turn
+  them into NamedShardings (FSDP on ``embed``, tensor-parallel on
+  ``heads``/``mlp``/``vocab``).  XLA emits all ICI collectives.
+- The KV cache is a *functional* argument (list of per-layer {k, v}
+  arrays) rather than a flax mutable collection, so the decode step
+  nests cleanly inside ``lax.while_loop`` in the rollout engine.
+- Compute dtype bf16, params f32, softmax/logits/logprobs f32.
+- ``remat=True`` wraps each block in ``jax.checkpoint`` (HBM↔FLOPs).
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from orion_tpu.config import ModelConfig
+from orion_tpu.ops.attention import attention
+from orion_tpu.ops.rotary import apply_rotary
+
+KVCache = List[dict]  # per-layer {"k": [B,L,Hkv,D], "v": [B,L,Hkv,D]}
+
+_dt = lambda s: jnp.dtype(s)  # noqa: E731
+
+
+def _dense(features, axes, use_bias, cfg, name):
+    return nn.Dense(
+        features=features,
+        use_bias=use_bias,
+        dtype=_dt(cfg.dtype),
+        param_dtype=_dt(cfg.param_dtype),
+        kernel_init=nn.with_logical_partitioning(
+            nn.initializers.normal(stddev=0.02), axes),
+        bias_init=nn.with_logical_partitioning(
+            nn.initializers.zeros_init(), (axes[-1],)),
+        name=name,
+    )
+
+
+def _norm(cfg, name):
+    if cfg.arch == "llama":
+        return nn.RMSNorm(
+            epsilon=cfg.rms_norm_eps, dtype=_dt(cfg.dtype),
+            param_dtype=_dt(cfg.param_dtype),
+            scale_init=nn.with_logical_partitioning(
+                nn.initializers.ones_init(), ("norm",)),
+            name=name)
+    return nn.LayerNorm(
+        epsilon=cfg.layernorm_eps, dtype=_dt(cfg.dtype),
+        param_dtype=_dt(cfg.param_dtype),
+        scale_init=nn.with_logical_partitioning(
+            nn.initializers.ones_init(), ("norm",)),
+        bias_init=nn.with_logical_partitioning(
+            nn.initializers.zeros_init(), ("norm",)),
+        name=name)
+
+
+class Attention(nn.Module):
+    cfg: ModelConfig
+
+    @nn.compact
+    def __call__(self, x, positions, layer_cache=None):
+        """x: [B, L, E]; positions: [B, L] absolute positions.
+
+        layer_cache: {"k","v"} [B, Lmax, Hkv, D] or None.  When a cache
+        is given, the L new keys/values are written at per-sequence
+        slots starting at ``positions[:, 0]`` — one formula covers
+        prefill (positions 0..L-1), chunked prefill (P..P+L-1) and
+        decode (positions = current lengths).
+        Returns (out [B, L, E], new_layer_cache).
+        """
+        cfg = self.cfg
+        B, L, _ = x.shape
+        H, Hkv, D = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
+
+        q = _dense(H * D, ("embed", "heads"), cfg.attn_bias, cfg, "q_proj")(x)
+        k = _dense(Hkv * D, ("embed", "kv_heads"), cfg.attn_bias, cfg, "k_proj")(x)
+        v = _dense(Hkv * D, ("embed", "kv_heads"), cfg.attn_bias, cfg, "v_proj")(x)
+        q = q.reshape(B, L, H, D)
+        k = k.reshape(B, L, Hkv, D)
+        v = v.reshape(B, L, Hkv, D)
+
+        rotary_dim = int(D * cfg.rotary_pct)
+        q, k = apply_rotary(q, k, positions, rotary_dim, cfg.rope_theta)
+
+        new_cache = None
+        if layer_cache is not None:
+            starts = positions[:, 0]
+
+            def write(cache, new):
+                return jax.vmap(
+                    lambda c, t, i: jax.lax.dynamic_update_slice(
+                        c, t, (i, 0, 0)))(cache, new, starts)
+
+            ck = write(layer_cache["k"], k)
+            cv = write(layer_cache["v"], v)
+            new_cache = {"k": ck, "v": cv}
+            keys, values = ck, cv
+        else:
+            keys, values = k, v
+
+        # Mask: query at absolute position p attends to cache slots
+        # j <= p.  Slots map 1:1 to absolute positions in both the
+        # prefill and decode paths (decode overwrites the right-padded
+        # prompt tail slot by slot), so one formula covers train,
+        # prefill and decode.
+        key_slots = jnp.arange(keys.shape[1], dtype=positions.dtype)
+        mask = key_slots[None, None, :] <= positions[:, :, None]
+
+        out = attention(q, keys, values, mask, scale=1.0 / D ** 0.5,
+                        impl=cfg.attention_impl)
+        out = out.reshape(B, L, H * D)
+        out = _dense(cfg.hidden_size, ("heads", "embed"),
+                     cfg.attn_bias, cfg, "o_proj")(out)
+        return out, new_cache
+
+
+class MLP(nn.Module):
+    cfg: ModelConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        if cfg.arch == "llama":
+            gate = _dense(cfg.intermediate_size, ("embed", "mlp"),
+                          cfg.mlp_bias, cfg, "gate_proj")(x)
+            up = _dense(cfg.intermediate_size, ("embed", "mlp"),
+                        cfg.mlp_bias, cfg, "up_proj")(x)
+            h = nn.silu(gate) * up
+            return _dense(cfg.hidden_size, ("mlp", "embed"),
+                          cfg.mlp_bias, cfg, "down_proj")(h)
+        h = _dense(cfg.intermediate_size, ("embed", "mlp"),
+                   cfg.mlp_bias, cfg, "up_proj")(x)
+        h = nn.gelu(h, approximate=False)
+        return _dense(cfg.hidden_size, ("mlp", "embed"),
+                      cfg.mlp_bias, cfg, "down_proj")(h)
+
+
+class Block(nn.Module):
+    cfg: ModelConfig
+
+    @nn.compact
+    def __call__(self, x, positions, layer_cache=None):
+        cfg = self.cfg
+        if cfg.use_parallel_residual:
+            # GPT-NeoX: x + attn(ln1(x)) + mlp(ln2(x))
+            attn_out, new_cache = Attention(cfg, name="attn")(
+                _norm(cfg, "input_norm")(x), positions, layer_cache)
+            mlp_out = MLP(cfg, name="mlp")(_norm(cfg, "post_attn_norm")(x))
+            return x + attn_out + mlp_out, new_cache
+        attn_out, new_cache = Attention(cfg, name="attn")(
+            _norm(cfg, "input_norm")(x), positions, layer_cache)
+        h = x + attn_out
+        mlp_out = MLP(cfg, name="mlp")(_norm(cfg, "post_attn_norm")(h))
+        return h + mlp_out, new_cache
+
+
+class Transformer(nn.Module):
+    """Backbone + LM head.
+
+    __call__ returns (logits_f32 [B, L, V], new_cache | None).
+    ``return_hidden=True`` additionally returns final-norm hidden states
+    (used by the value/reward heads).
+    """
+
+    cfg: ModelConfig
+
+    @nn.compact
+    def __call__(self, input_ids, positions, cache: Optional[KVCache] = None,
+                 return_hidden: bool = False, skip_lm_head: bool = False):
+        cfg = self.cfg
+        embed = nn.Embed(
+            num_embeddings=cfg.vocab_size, features=cfg.hidden_size,
+            dtype=_dt(cfg.dtype), param_dtype=_dt(cfg.param_dtype),
+            embedding_init=nn.with_logical_partitioning(
+                nn.initializers.normal(stddev=0.02), ("vocab", "embed")),
+            name="embed")
+        x = embed(input_ids)
+
+        block_cls = Block
+        if cfg.remat:
+            block_cls = nn.remat(Block, static_argnums=())
+
+        new_cache: Optional[KVCache] = [] if cache is not None else None
+        for i in range(cfg.num_layers):
+            layer_cache = cache[i] if cache is not None else None
+            x, new_layer_cache = block_cls(cfg, name=f"layers_{i}")(
+                x, positions, layer_cache)
+            if new_cache is not None:
+                new_cache.append(new_layer_cache)
+
+        x = _norm(cfg, "final_norm")(x)
+        hidden = x
+        if skip_lm_head:
+            # Heads-only callers (critic/RM) skip the vocab projection —
+            # at Llama-3 scale that is the largest matmul in the model
+            # and its f32 logits would be materialized only to be
+            # discarded.  lm_head params are never created on this path.
+            return None, new_cache, hidden
+        if cfg.tie_word_embeddings:
+            logits = embed.attend(x)
+        else:
+            logits = _dense(cfg.vocab_size, ("embed", "vocab"),
+                            False, cfg, "lm_head")(x)
+        logits = logits.astype(jnp.float32)
+        if return_hidden:
+            return logits, new_cache, hidden
+        return logits, new_cache
+
+
+# ---------------------------------------------------------------------------
+# Init / cache helpers
+# ---------------------------------------------------------------------------
+
+
+def init_cache(cfg: ModelConfig, batch: int, max_len: int,
+               dtype: Optional[Any] = None) -> KVCache:
+    """Dense pre-allocated KV cache (rollout engine v0; paged cache in
+    orion_tpu.rollout.kv_cache upgrades this)."""
+    dtype = dtype or _dt(cfg.dtype)
+    shape = (batch, max_len, cfg.num_kv_heads, cfg.head_dim)
+    return [{"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+            for _ in range(cfg.num_layers)]
+
+
+def init_params(model: nn.Module, rng: jax.Array, cfg: ModelConfig,
+                unbox: bool = True):
+    """Initialize params (tiny dummy batch).  Returns unboxed param tree."""
+    ids = jnp.zeros((1, 2), jnp.int32)
+    pos = jnp.zeros((1, 2), jnp.int32)
+    variables = model.init(rng, ids, pos)
+    params = variables["params"]
+    return nn.meta.unbox(params) if unbox else params
+
+
+def logical_specs(model: nn.Module, cfg: ModelConfig):
+    """Pytree of logical-axis PartitionSpecs matching the param tree."""
+    ids = jax.ShapeDtypeStruct((1, 2), jnp.int32)
+    variables = jax.eval_shape(model.init, jax.random.key(0), ids, ids)
+    return nn.get_partition_spec(variables)["params"]

@@ -1,0 +1,3 @@
+from orion_tpu.ops.attention import reference_attention, attention  # noqa: F401
+from orion_tpu.ops.rotary import apply_rotary, rope_cos_sin  # noqa: F401
+from orion_tpu.ops.sampling import sample_tokens  # noqa: F401

@@ -1,0 +1,59 @@
+"""Attention ops: reference jnp implementation + impl dispatch.
+
+The dispatcher lets the model config choose between the pure-XLA
+reference einsum (always correct, XLA-fused) and the Pallas kernels
+(flash for training, paged/ragged for decode) once those are built
+(SURVEY.md §2 #13).  GQA is handled here by repeating KV heads.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+_NEG_INF = -0.7 * float(jnp.finfo(jnp.float32).max)
+
+
+def repeat_kv(x: jnp.ndarray, n_rep: int) -> jnp.ndarray:
+    """[B, L, Hkv, D] -> [B, L, Hkv*n_rep, D] (GQA head expansion)."""
+    if n_rep == 1:
+        return x
+    b, l, h, d = x.shape
+    return jnp.broadcast_to(
+        x[:, :, :, None, :], (b, l, h, n_rep, d)).reshape(b, l, h * n_rep, d)
+
+
+def reference_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                        mask: jnp.ndarray, scale: float) -> jnp.ndarray:
+    """Masked multi-head attention, softmax in f32.
+
+    q: [B, Lq, H, D], k/v: [B, Lk, H, D], mask: [B, Lq, Lk] bool
+    (True = attend).  Returns [B, Lq, H, D] in q.dtype.
+    """
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    scores = jnp.where(mask[:, None, :, :], scores, _NEG_INF)
+    probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(q.dtype), v)
+    return out
+
+
+def attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+              mask: jnp.ndarray, scale: float,
+              impl: str = "reference",
+              segment_positions: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+    """Dispatch on attention implementation.
+
+    impl: "auto"|"reference" -> jnp einsum; "flash" -> Pallas flash
+    attention (training shapes); decode paths call the paged kernel
+    directly from the rollout engine.
+    """
+    n_rep = q.shape[2] // k.shape[2]
+    if impl == "flash":
+        from orion_tpu.ops.pallas.flash_attention import flash_attention_gqa
+        return flash_attention_gqa(q, k, v, mask, scale)
+    k = repeat_kv(k, n_rep)
+    v = repeat_kv(v, n_rep)
+    return reference_attention(q, k, v, mask, scale)

@@ -1,0 +1,49 @@
+"""Rotary position embeddings.
+
+Supports full rotary (Llama) and partial rotary (GPT-NeoX ``rotary_pct``,
+e.g. 0.25 for Pythia).  Uses the non-interleaved "rotate_half" layout both
+model families share in their canonical implementations.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def rope_cos_sin(positions: jnp.ndarray, rotary_dim: int,
+                 theta: float) -> tuple:
+    """cos/sin tables for integer positions.
+
+    positions: [B, L] int32 → cos, sin: [B, L, rotary_dim] float32.
+    """
+    inv_freq = 1.0 / (theta ** (
+        jnp.arange(0, rotary_dim, 2, dtype=jnp.float32) / rotary_dim))
+    angles = positions.astype(jnp.float32)[..., None] * inv_freq  # [B,L,rd/2]
+    emb = jnp.concatenate([angles, angles], axis=-1)  # [B,L,rd]
+    return jnp.cos(emb), jnp.sin(emb)
+
+
+def _rotate_half(x: jnp.ndarray) -> jnp.ndarray:
+    half = x.shape[-1] // 2
+    x1, x2 = x[..., :half], x[..., half:]
+    return jnp.concatenate([-x2, x1], axis=-1)
+
+
+def apply_rotary(q: jnp.ndarray, k: jnp.ndarray, positions: jnp.ndarray,
+                 rotary_dim: int, theta: float) -> tuple:
+    """Apply (possibly partial) rotary embedding.
+
+    q: [B, L, Hq, D], k: [B, L, Hk, D], positions: [B, L].
+    Only the first ``rotary_dim`` features of each head are rotated.
+    """
+    cos, sin = rope_cos_sin(positions, rotary_dim, theta)  # [B,L,rd]
+    cos = cos[:, :, None, :]  # broadcast over heads
+    sin = sin[:, :, None, :]
+
+    def rot(x):
+        xr, xp = x[..., :rotary_dim], x[..., rotary_dim:]
+        xr32 = xr.astype(jnp.float32)
+        xr = (xr32 * cos + _rotate_half(xr32) * sin).astype(x.dtype)
+        return jnp.concatenate([xr, xp], axis=-1) if xp.shape[-1] else xr
+
+    return rot(q), rot(k)

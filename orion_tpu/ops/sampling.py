@@ -1,0 +1,60 @@
+"""Token sampling: temperature / top-k / top-p, with logprob capture.
+
+Returns the logprob of the sampled token under the *actual* sampling
+distribution (post temperature + truncation) — this is the behavioral
+policy used for importance ratios in the off-policy/async path; trainers
+additionally recompute logprobs under the training graph (SURVEY.md §4
+"logprob parity").  Logprobs are computed in f32 (bf16 softmax drift is
+hard-part #4 in SURVEY.md §7).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+_NEG_INF = jnp.float32(-1e10)
+
+
+def _mask_top_k(logits: jnp.ndarray, top_k: int) -> jnp.ndarray:
+    vals, _ = jax.lax.top_k(logits, top_k)
+    threshold = vals[..., -1:]
+    return jnp.where(logits < threshold, _NEG_INF, logits)
+
+
+def _mask_top_p(logits: jnp.ndarray, top_p: float) -> jnp.ndarray:
+    sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+    probs = jax.nn.softmax(sorted_logits, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    # Keep tokens while cumulative prob *before* them is < top_p
+    # (always keeps the top token).
+    keep_sorted = (cum - probs) < top_p
+    n_keep = jnp.sum(keep_sorted, axis=-1, keepdims=True)
+    # Threshold = smallest kept logit.
+    idx = jnp.clip(n_keep - 1, 0, logits.shape[-1] - 1)
+    threshold = jnp.take_along_axis(sorted_logits, idx, axis=-1)
+    return jnp.where(logits < threshold, _NEG_INF, logits)
+
+
+def sample_tokens(rng: jax.Array, logits: jnp.ndarray, temperature: float,
+                  top_k: int = 0, top_p: float = 1.0) -> tuple:
+    """Sample next tokens from [B, V] logits.
+
+    Returns (tokens [B] int32, logprobs [B] f32).  temperature == 0.0
+    means greedy (logprob computed from the untempered distribution).
+    """
+    logits = logits.astype(jnp.float32)
+    if temperature == 0.0:
+        tokens = jnp.argmax(logits, axis=-1)
+        logps = jax.nn.log_softmax(logits, axis=-1)
+        return tokens.astype(jnp.int32), jnp.take_along_axis(
+            logps, tokens[:, None], axis=-1)[:, 0]
+    logits = logits / temperature
+    if top_k > 0:
+        logits = _mask_top_k(logits, top_k)
+    if top_p < 1.0:
+        logits = _mask_top_p(logits, top_p)
+    logps = jax.nn.log_softmax(logits, axis=-1)
+    tokens = jax.random.categorical(rng, logits, axis=-1)
+    return tokens.astype(jnp.int32), jnp.take_along_axis(
+        logps, tokens[:, None], axis=-1)[:, 0]

@@ -1,0 +1,11 @@
+from orion_tpu.parallel.mesh import (  # noqa: F401
+    make_mesh,
+    make_cpu_test_mesh,
+    MeshContext,
+)
+from orion_tpu.parallel.sharding import (  # noqa: F401
+    LOGICAL_RULES,
+    logical_to_sharding,
+    param_shardings,
+    shard_params,
+)

@@ -1,0 +1,81 @@
+"""Device-mesh construction.
+
+The TPU-native replacement for the reference's NCCL process groups
+(SURVEY.md §2 #12, §5 "Distributed communication backend"): all
+collectives are emitted by XLA from sharding annotations over a
+`jax.sharding.Mesh`; there is no user-space communication library.
+
+Axes: ("data", "fsdp", "seq", "tensor") — see
+:class:`orion_tpu.config.MeshConfig`.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from orion_tpu.config import MeshConfig
+
+
+def make_mesh(cfg: MeshConfig, devices: Optional[Sequence] = None) -> Mesh:
+    """Build a Mesh from the (possibly partially-specified) MeshConfig.
+
+    ``devices`` defaults to all local+global devices.  Axis order places
+    ``data`` outermost and ``tensor`` innermost so that tensor-parallel
+    collectives ride the fastest ICI links while data-parallel reductions
+    tolerate slower (DCN) hops — the standard TPU layout recipe.
+    """
+    if devices is None:
+        devices = jax.devices()
+    devices = np.asarray(devices)
+    shape = cfg.resolved_shape(devices.size)
+    return Mesh(devices.reshape(shape), cfg.axis_names)
+
+
+def make_cpu_test_mesh(shape: dict | None = None) -> Mesh:
+    """8-fake-CPU-device mesh for tests (SURVEY.md §4).
+
+    Requires XLA_FLAGS=--xla_force_host_platform_device_count=8 (set in
+    tests/conftest.py before jax import).
+    """
+    shape = shape or {"data": 1, "fsdp": -1, "seq": 1, "tensor": 1}
+    cfg = MeshConfig(**shape)
+    return make_mesh(cfg)
+
+
+class MeshContext:
+    """Carries the mesh plus derived helper state through the stack."""
+
+    def __init__(self, mesh: Mesh):
+        self.mesh = mesh
+
+    @property
+    def n_data(self) -> int:
+        return self.mesh.shape["data"]
+
+    @property
+    def n_fsdp(self) -> int:
+        return self.mesh.shape["fsdp"]
+
+    @property
+    def n_tensor(self) -> int:
+        return self.mesh.shape["tensor"]
+
+    @property
+    def n_seq(self) -> int:
+        return self.mesh.shape["seq"]
+
+    @property
+    def batch_axes(self) -> tuple:
+        """Mesh axes over which the batch dimension is sharded."""
+        return ("data", "fsdp")
+
+    def __enter__(self):
+        self._ctx = self.mesh.__enter__()
+        return self
+
+    def __exit__(self, *a):
+        return self.mesh.__exit__(*a)

@@ -1,0 +1,81 @@
+"""Logical-axis → mesh-axis sharding rules.
+
+The reference shards its actor FSDP-style via torch FSDP + NCCL
+(SURVEY.md §2 #9).  Here sharding is declarative: every parameter is
+annotated with *logical* axis names at init time, and these rules map
+logical names to mesh axes.  XLA then inserts the all-gathers /
+reduce-scatters over ICI — the compiler is the communication backend.
+
+Rules (MaxText/T5X-style):
+  embed   — the hidden/model dimension    → fsdp (ZeRO-3 shard axis)
+  mlp     — the ffn intermediate dim      → tensor
+  heads   — attention heads × head_dim    → tensor
+  kv_heads— kv heads (GQA)                → tensor
+  vocab   — embedding/unembedding vocab   → tensor
+  layers  — scanned layer stack dimension → (replicated)
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# logical axis name -> mesh axis (or None => replicate)
+LOGICAL_RULES: dict = {
+    "embed": "fsdp",
+    "mlp": "tensor",
+    "heads": "tensor",
+    "kv_heads": "tensor",
+    "vocab": "tensor",
+    "layers": None,
+    "norm": None,
+    "batch": ("data", "fsdp"),
+    "seq": "seq",
+}
+
+
+def spec_from_logical(logical_axes: tuple, rules: Optional[dict] = None) -> P:
+    rules = rules or LOGICAL_RULES
+    return P(*(rules.get(name) for name in logical_axes))
+
+
+def logical_to_sharding(logical_axes: tuple, mesh: Mesh,
+                        rules: Optional[dict] = None) -> NamedSharding:
+    return NamedSharding(mesh, spec_from_logical(logical_axes, rules))
+
+
+def param_shardings(abstract_params: Any, logical_axes: Any, mesh: Mesh,
+                    rules: Optional[dict] = None) -> Any:
+    """Map a pytree of logical-axis tuples to a pytree of NamedShardings.
+
+    ``logical_axes`` mirrors the param tree; leaves are tuples of logical
+    names (one per array dim) or None (replicate).
+    """
+    def one(axes, p):
+        if axes is None:
+            return NamedSharding(mesh, P())
+        return logical_to_sharding(axes, mesh, rules)
+
+    return jax.tree.map(
+        one, logical_axes, abstract_params,
+        is_leaf=lambda x: x is None or (isinstance(x, tuple) and
+                                        all(isinstance(e, (str, type(None))) for e in x)))
+
+
+def shard_params(params: Any, logical_axes: Any, mesh: Mesh,
+                 rules: Optional[dict] = None) -> Any:
+    """Device_put a host param tree onto the mesh with the given rules."""
+    shardings = param_shardings(params, logical_axes, mesh, rules)
+    return jax.device_put(params, shardings)
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def batch_sharding(mesh: Mesh, rules: Optional[dict] = None) -> NamedSharding:
+    """Sharding for [batch, seq, ...] activations / token arrays."""
+    rules = rules or LOGICAL_RULES
+    return NamedSharding(mesh, P(rules["batch"]))

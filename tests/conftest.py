@@ -1,0 +1,27 @@
+"""Test harness: 8 fake CPU devices (SURVEY.md §4).
+
+The box's sitecustomize imports jax and registers the experimental
+'axon' TPU plugin before pytest starts, so plain env vars are stale by
+the time this file runs.  jax.config.update still works because the
+backends themselves are initialized lazily on first use.
+"""
+
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_default_matmul_precision", "highest")
+if getattr(jax, "_src", None) is not None:
+    # If sitecustomize already touched a backend, drop it so the CPU
+    # platform + forced device count take effect.
+    try:
+        jax._src.xla_bridge._clear_backends()
+    except Exception:
+        pass

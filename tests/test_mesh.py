@@ -1,0 +1,67 @@
+import jax
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from orion_tpu.config import MeshConfig, ModelConfig, PPOConfig, load_config
+from orion_tpu.parallel import make_mesh, make_cpu_test_mesh
+from orion_tpu.parallel.sharding import (
+    LOGICAL_RULES, spec_from_logical, logical_to_sharding, shard_params)
+
+
+def test_eight_fake_devices():
+    assert jax.device_count() == 8
+
+
+def test_mesh_resolution():
+    cfg = MeshConfig(data=1, fsdp=-1, seq=1, tensor=2)
+    assert cfg.resolved_shape(8) == (1, 4, 1, 2)
+    cfg = MeshConfig(data=2, fsdp=2, seq=1, tensor=2)
+    assert cfg.resolved_shape(8) == (2, 2, 1, 2)
+    with pytest.raises(ValueError):
+        MeshConfig(data=3, fsdp=-1).resolved_shape(8)
+
+
+def test_make_mesh():
+    mesh = make_mesh(MeshConfig(data=2, fsdp=2, seq=1, tensor=2))
+    assert mesh.shape == {"data": 2, "fsdp": 2, "seq": 1, "tensor": 2}
+
+
+def test_specs():
+    assert spec_from_logical(("embed", "mlp")) == P("fsdp", "tensor")
+    assert spec_from_logical(("vocab", "embed")) == P("tensor", "fsdp")
+    assert spec_from_logical(("norm",)) == P(None)
+
+
+def test_shard_params_places_arrays():
+    mesh = make_cpu_test_mesh()
+    params = {"w": np.ones((16, 8), np.float32), "b": np.ones((8,), np.float32)}
+    axes = {"w": ("embed", "mlp"), "b": None}
+    sharded = shard_params(params, axes, mesh)
+    # w sharded over fsdp on dim 0 (8 devices => 2 rows per shard)
+    assert sharded["w"].sharding.spec == P("fsdp", "tensor")
+    np.testing.assert_array_equal(np.asarray(sharded["w"]), params["w"])
+
+
+def test_model_config_presets():
+    c = ModelConfig.pythia_1b()
+    assert c.arch == "neox" and c.use_parallel_residual and c.rotary_pct == 0.25
+    c = ModelConfig.llama3_8b()
+    assert c.num_kv_heads == 8 and c.head_dim == 128
+    t = ModelConfig.tiny()
+    assert t.head_dim == 16
+
+
+def test_config_overrides():
+    cfg = load_config(PPOConfig, cli_args=[
+        "model.hidden_size=128", "optimizer.learning_rate=3e-6",
+        "clip_ratio=0.3", "whiten_advantages=false"])
+    assert cfg.model.hidden_size == 128
+    assert cfg.optimizer.learning_rate == 3e-6
+    assert cfg.clip_ratio == 0.3
+    assert cfg.whiten_advantages is False
+
+
+def test_config_tuple_override():
+    cfg = load_config(PPOConfig, cli_args=["optimizer.betas=0.9,0.99"])
+    assert cfg.optimizer.betas == (0.9, 0.99)

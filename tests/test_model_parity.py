@@ -1,0 +1,185 @@
+"""Numerical parity vs HuggingFace torch implementations (CPU).
+
+Builds tiny randomly-initialized HF Llama / GPT-NeoX models, converts
+their weights with orion_tpu.models.hf_loader, and checks logits match.
+This validates the whole model stack: rotary convention, GQA, norms,
+parallel residual, fused-qkv de-interleave, head mapping.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from orion_tpu.models import Transformer
+from orion_tpu.models.hf_loader import convert_hf_state_dict, config_from_hf
+
+torch = pytest.importorskip("torch")
+
+
+def _run_ours(cfg, params, ids):
+    model = Transformer(cfg)
+    positions = jnp.broadcast_to(jnp.arange(ids.shape[1]), ids.shape)
+    logits, _ = model.apply({"params": params}, jnp.asarray(ids), positions)
+    return np.asarray(logits)
+
+
+@pytest.fixture(scope="module")
+def hf_llama():
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    hf_cfg = LlamaConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=112,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=128, rms_norm_eps=1e-5, rope_theta=10000.0,
+        tie_word_embeddings=False, attention_bias=False)
+    torch.manual_seed(0)
+    return LlamaForCausalLM(hf_cfg).eval()
+
+
+@pytest.fixture(scope="module")
+def hf_neox():
+    from transformers import GPTNeoXConfig, GPTNeoXForCausalLM
+
+    hf_cfg = GPTNeoXConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=256,
+        num_hidden_layers=2, num_attention_heads=4,
+        max_position_embeddings=128, rotary_pct=0.25,
+        use_parallel_residual=True, layer_norm_eps=1e-5,
+        tie_word_embeddings=False)
+    torch.manual_seed(1)
+    return GPTNeoXForCausalLM(hf_cfg).eval()
+
+
+def _parity(hf_model, rtol=2e-4, atol=2e-4):
+    cfg = config_from_hf(hf_model.config)
+    cfg.dtype = "float32"
+    params = convert_hf_state_dict(hf_model.state_dict(), cfg)
+    rng = np.random.RandomState(42)
+    ids = rng.randint(0, cfg.vocab_size, size=(2, 17))
+    with torch.no_grad():
+        ref = hf_model(torch.tensor(ids)).logits.numpy()
+    ours = _run_ours(cfg, params, ids)
+    np.testing.assert_allclose(ours, ref, rtol=rtol, atol=atol)
+
+
+def test_llama_parity(hf_llama):
+    _parity(hf_llama)
+
+
+def test_neox_parity(hf_neox):
+    _parity(hf_neox)
+
+
+def test_gqa_heads_differ_from_mha(hf_llama):
+    # sanity: converted model is GQA (2 kv heads vs 4 q heads)
+    cfg = config_from_hf(hf_llama.config)
+    assert cfg.num_kv_heads == 2 and cfg.num_heads == 4
+
+
+def test_prefill_decode_matches_full_forward():
+    """Cache path parity: prefill + stepwise decode == full causal fwd."""
+    from orion_tpu.config import ModelConfig
+    from orion_tpu.models.transformer import init_cache, init_params
+
+    cfg = ModelConfig.tiny(dtype="float32")
+    model = Transformer(cfg)
+    params = init_params(model, jax.random.key(0), cfg)
+
+    B, L = 2, 10
+    ids = jax.random.randint(jax.random.key(1), (B, L), 0, cfg.vocab_size)
+    positions = jnp.broadcast_to(jnp.arange(L), (B, L))
+    full_logits, _ = model.apply({"params": params}, ids, positions)
+
+    # prefill first 6 tokens, then decode tokens 6..9 one at a time
+    P = 6
+    cache = init_cache(cfg, B, L, dtype=jnp.float32)
+    pre_logits, cache = model.apply(
+        {"params": params}, ids[:, :P],
+        jnp.broadcast_to(jnp.arange(P), (B, P)), cache)
+    np.testing.assert_allclose(
+        np.asarray(pre_logits), np.asarray(full_logits[:, :P]),
+        rtol=1e-5, atol=1e-5)
+    lens = jnp.full((B,), P, jnp.int32)
+    for t in range(P, L):
+        step_logits, cache = model.apply(
+            {"params": params}, ids[:, t:t + 1], lens[:, None], cache)
+        np.testing.assert_allclose(
+            np.asarray(step_logits[:, 0]), np.asarray(full_logits[:, t]),
+            rtol=1e-5, atol=1e-5)
+        lens = lens + 1
+
+
+def test_neox_sequential_residual_parity():
+    """use_parallel_residual=False must not be clobbered (HF checkpoints
+    with either value exist)."""
+    from transformers import GPTNeoXConfig, GPTNeoXForCausalLM
+
+    hf_cfg = GPTNeoXConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=256,
+        num_hidden_layers=2, num_attention_heads=4,
+        max_position_embeddings=128, rotary_pct=0.25,
+        use_parallel_residual=False, layer_norm_eps=1e-5,
+        tie_word_embeddings=False)
+    torch.manual_seed(2)
+    _parity(GPTNeoXForCausalLM(hf_cfg).eval())
+
+
+def test_chunked_prefill_matches_full_forward():
+    """Cache writes start at positions[:, 0]: a second prefill chunk at
+    offset P must not clobber the first chunk's cache slots."""
+    from orion_tpu.config import ModelConfig
+    from orion_tpu.models.transformer import init_cache, init_params
+
+    cfg = ModelConfig.tiny(dtype="float32")
+    model = Transformer(cfg)
+    params = init_params(model, jax.random.key(0), cfg)
+
+    B, L, P = 2, 12, 5
+    ids = jax.random.randint(jax.random.key(7), (B, L), 0, cfg.vocab_size)
+    positions = jnp.broadcast_to(jnp.arange(L), (B, L))
+    full_logits, _ = model.apply({"params": params}, ids, positions)
+
+    cache = init_cache(cfg, B, L, dtype=jnp.float32)
+    _, cache = model.apply({"params": params}, ids[:, :P],
+                           positions[:, :P], cache)
+    chunk2_logits, _ = model.apply({"params": params}, ids[:, P:],
+                                   positions[:, P:], cache)
+    np.testing.assert_allclose(
+        np.asarray(chunk2_logits), np.asarray(full_logits[:, P:]),
+        rtol=1e-5, atol=1e-5)
+
+
+def test_ragged_decode_respects_lengths():
+    """Right-padded prompts with different lengths decode correctly."""
+    from orion_tpu.config import ModelConfig
+    from orion_tpu.models.transformer import init_cache, init_params
+
+    cfg = ModelConfig.tiny(dtype="float32")
+    model = Transformer(cfg)
+    params = init_params(model, jax.random.key(0), cfg)
+
+    max_len = 12
+    ids_a = jax.random.randint(jax.random.key(2), (1, 5), 0, cfg.vocab_size)
+    # batch: seq A (len 5, padded to 8), decode 1 step; compare against
+    # running seq A alone unpadded.
+    pad = jnp.zeros((1, 3), jnp.int32)
+    ids_padded = jnp.concatenate([ids_a, pad], axis=1)
+
+    cache = init_cache(cfg, 1, max_len, dtype=jnp.float32)
+    _, cache = model.apply(
+        {"params": params}, ids_padded,
+        jnp.broadcast_to(jnp.arange(8), (1, 8)), cache)
+    lens = jnp.array([5], jnp.int32)
+    next_tok = jax.random.randint(jax.random.key(3), (1, 1), 0, cfg.vocab_size)
+    step_logits, _ = model.apply(
+        {"params": params}, next_tok, lens[:, None], cache)
+
+    # reference: unpadded forward over [ids_a, next_tok]
+    ref_ids = jnp.concatenate([ids_a, next_tok], axis=1)
+    ref_logits, _ = model.apply(
+        {"params": params}, ref_ids,
+        jnp.broadcast_to(jnp.arange(6), (1, 6)))
+    np.testing.assert_allclose(
+        np.asarray(step_logits[:, 0]), np.asarray(ref_logits[:, 5]),
+        rtol=1e-5, atol=1e-5)
