@@ -1,0 +1,19 @@
+from orion_tpu.algos.advantages import (  # noqa: F401
+    gae,
+    grpo_advantages,
+    rloo_advantages,
+    masked_mean,
+    masked_whiten,
+    per_token_rewards,
+)
+from orion_tpu.algos.kl import (  # noqa: F401
+    kl_penalty,
+    AdaptiveKLController,
+    FixedKLController,
+)
+from orion_tpu.algos.losses import (  # noqa: F401
+    ppo_policy_loss,
+    ppo_value_loss,
+    dpo_loss,
+    reinforce_loss,
+)

@@ -1,0 +1,49 @@
+"""KL estimators and controllers.
+
+Estimators follow the standard k1/k2/k3 family: given per-token
+logprobs of the policy (lp) and the frozen reference (ref_lp),
+
+  k1 = lp - ref_lp                     (unbiased, high variance)
+  k2 = (lp - ref_lp)^2 / 2
+  k3 = exp(ref_lp - lp) - 1 + (lp - ref_lp)   (unbiased, low variance)
+
+The adaptive controller scales kl_coef to track a target KL (the
+classic PPO-RLHF scheme).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def kl_penalty(lp: jnp.ndarray, ref_lp: jnp.ndarray,
+               kind: str = "k1") -> jnp.ndarray:
+    diff = lp - ref_lp
+    if kind == "k1":
+        return diff
+    if kind == "k2":
+        return 0.5 * diff ** 2
+    if kind == "k3":
+        return jnp.exp(-diff) - 1.0 + diff
+    raise ValueError(f"unknown KL estimator: {kind}")
+
+
+class FixedKLController:
+    def __init__(self, kl_coef: float):
+        self.value = kl_coef
+
+    def update(self, current_kl: float, n_steps: int) -> None:
+        pass
+
+
+class AdaptiveKLController:
+    """Proportional controller: coef *= (1 + clip(err, ±0.2) * n/horizon)."""
+
+    def __init__(self, init_kl_coef: float, target: float, horizon: int):
+        self.value = init_kl_coef
+        self.target = target
+        self.horizon = horizon
+
+    def update(self, current_kl: float, n_steps: int) -> None:
+        error = min(max(current_kl / self.target - 1.0, -0.2), 0.2)
+        self.value *= 1.0 + error * n_steps / self.horizon

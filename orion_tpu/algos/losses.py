@@ -1,0 +1,109 @@
+"""Loss functions for the four algorithm families (SURVEY.md §2 #1-4).
+
+All are pure jittable functions over [B, T] token tensors (or [B]
+sequence tensors for DPO) returning (loss_scalar, stats_dict).
+Everything is computed in f32.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from orion_tpu.algos.advantages import masked_mean
+
+
+def ppo_policy_loss(logprobs: jnp.ndarray, old_logprobs: jnp.ndarray,
+                    advantages: jnp.ndarray, mask: jnp.ndarray,
+                    clip_ratio: float) -> tuple:
+    """Clipped surrogate objective over completion tokens.
+
+    The same function serves PPO (GAE token advantages) and GRPO
+    (group-relative sequence advantage broadcast over tokens) — the
+    importance ratio uses old behavioral logprobs in both, which also
+    provides the staleness correction in async/off-policy mode
+    (SURVEY.md §3b).
+    """
+    logratio = (logprobs - old_logprobs) * mask
+    ratio = jnp.exp(logratio)
+    unclipped = -advantages * ratio
+    clipped = -advantages * jnp.clip(ratio, 1.0 - clip_ratio, 1.0 + clip_ratio)
+    loss_tok = jnp.maximum(unclipped, clipped)
+    loss = masked_mean(loss_tok, mask)
+    stats = {
+        "policy_loss": loss,
+        "clip_frac": masked_mean(
+            (jnp.abs(ratio - 1.0) > clip_ratio).astype(jnp.float32), mask),
+        "approx_kl": masked_mean(0.5 * logratio ** 2, mask),
+        "ratio_mean": masked_mean(ratio, mask),
+    }
+    return loss, stats
+
+
+def ppo_value_loss(values: jnp.ndarray, old_values: jnp.ndarray,
+                   returns: jnp.ndarray, mask: jnp.ndarray,
+                   value_clip: float) -> tuple:
+    """Clipped value loss (0.5 * max(sq, clipped_sq), TRL/openai style)."""
+    clipped_values = old_values + jnp.clip(
+        values - old_values, -value_clip, value_clip)
+    sq = (values - returns) ** 2
+    sq_clipped = (clipped_values - returns) ** 2
+    loss = 0.5 * masked_mean(jnp.maximum(sq, sq_clipped), mask)
+    stats = {
+        "value_loss": loss,
+        "value_clip_frac": masked_mean(
+            (sq_clipped > sq).astype(jnp.float32), mask),
+    }
+    return loss, stats
+
+
+def reinforce_loss(logprobs: jnp.ndarray, advantages: jnp.ndarray,
+                   mask: jnp.ndarray,
+                   old_logprobs: Optional[jnp.ndarray] = None) -> tuple:
+    """REINFORCE with optional one-step importance correction (RLOO
+    async mode): loss = -adv · ratio · logprob-grad.  With
+    old_logprobs=None this is plain -adv·logprob; sequence-level
+    advantages arrive already broadcast to [B, T]."""
+    if old_logprobs is None:
+        loss_tok = -advantages * logprobs
+    else:
+        ratio = jax.lax.stop_gradient(
+            jnp.exp((logprobs - old_logprobs) * mask))
+        loss_tok = -advantages * ratio * logprobs
+    loss = masked_mean(loss_tok, mask)
+    return loss, {"policy_loss": loss}
+
+
+def dpo_loss(policy_chosen_lp: jnp.ndarray, policy_rejected_lp: jnp.ndarray,
+             ref_chosen_lp: jnp.ndarray, ref_rejected_lp: jnp.ndarray,
+             beta: float, label_smoothing: float = 0.0,
+             pair_weight: Optional[jnp.ndarray] = None) -> tuple:
+    """Sequence-level DPO loss on (chosen, rejected) pairs ([B] each,
+    summed logprobs over completion tokens).
+
+    pair_weight ([B], optional) downweights/masks pairs — online-DPO
+    uses it to zero out tied pairs, where the chosen/rejected split is
+    arbitrary and the gradient would be pure noise.
+    """
+    chosen_ratio = policy_chosen_lp - ref_chosen_lp
+    rejected_ratio = policy_rejected_lp - ref_rejected_lp
+    logits = beta * (chosen_ratio - rejected_ratio)
+    per_pair = (-jax.nn.log_sigmoid(logits) * (1.0 - label_smoothing)
+                - jax.nn.log_sigmoid(-logits) * label_smoothing)
+    if pair_weight is None:
+        pair_weight = jnp.ones_like(per_pair)
+    denom = jnp.maximum(jnp.sum(pair_weight), 1.0)
+    loss = jnp.sum(per_pair * pair_weight) / denom
+    stats = {
+        "dpo_loss": loss,
+        "chosen_reward": jnp.sum(beta * chosen_ratio * pair_weight) / denom,
+        "rejected_reward": jnp.sum(
+            beta * rejected_ratio * pair_weight) / denom,
+        "accuracy": jnp.sum(
+            (logits > 0).astype(jnp.float32) * pair_weight) / denom,
+        "margin": jnp.sum(logits * pair_weight) / denom,
+        "tied_frac": 1.0 - jnp.mean(pair_weight),
+    }
+    return loss, stats

@@ -1,0 +1,70 @@
+"""Logprob utilities shared by the rollout engine and the trainers.
+
+The classic RLHF bug class is trainer/sampler logprob mismatch
+(SURVEY.md §4 "Parity"); these helpers are the single source of truth
+for how logprobs are computed (always f32) and how completion tokens
+align with logits in the packed layout.
+
+Packed layout: a sequence row is [prompt(0..len-1) | completion(len..
+len+clen-1) | pad].  The model's logits at index i predict token i+1,
+so the logprob of completion token j (absolute index len+j) reads from
+logits index len+j-1.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def token_logprobs(logits: jnp.ndarray, tokens: jnp.ndarray) -> jnp.ndarray:
+    """logp[b, t] = log P(tokens[b, t+1] | logits[b, t]).
+
+    logits: [B, L, V] (any float dtype; softmax in f32),
+    tokens: [B, L] → returns [B, L-1] f32.
+    """
+    logps = jax.nn.log_softmax(logits[:, :-1].astype(jnp.float32), axis=-1)
+    return jnp.take_along_axis(
+        logps, tokens[:, 1:, None], axis=-1)[..., 0]
+
+
+def completion_logprobs(logits: jnp.ndarray, sequences: jnp.ndarray,
+                        prompt_lens: jnp.ndarray,
+                        max_new_tokens: int) -> jnp.ndarray:
+    """Per-completion-token logprobs from a full forward over packed
+    sequences.  Returns [B, T] aligned with the engine's completions
+    (caller masks positions >= completion length)."""
+    all_lp = token_logprobs(logits, sequences)  # [B, L-1]; lp of token t+1 at t
+    # completion token j sits at abs index prompt_len + j; its logprob is
+    # all_lp[:, prompt_len + j - 1].
+    idx = prompt_lens[:, None] + jnp.arange(max_new_tokens)[None, :] - 1
+    idx = jnp.clip(idx, 0, all_lp.shape[1] - 1)
+    return jnp.take_along_axis(all_lp, idx, axis=1)
+
+
+def entropy_from_logits(logits: jnp.ndarray) -> jnp.ndarray:
+    """Per-position entropy, f32: [B, L, V] → [B, L]."""
+    logits = logits.astype(jnp.float32)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    p = jnp.exp(logp)
+    return -jnp.sum(p * logp, axis=-1)
+
+
+def pack_sequences(prompt_ids: jnp.ndarray, prompt_lens: jnp.ndarray,
+                   completions: jnp.ndarray) -> jnp.ndarray:
+    """Right-pack prompts and completions contiguously.
+
+    prompt_ids: [B, P] right-padded, completions: [B, T] →
+    sequences [B, P+T] where row b is
+    [prompt(0..len_b-1) | completion(0..T-1) | junk-from-overlap].
+    Callers mask with lengths; the completion window is written at
+    offset len_b so real tokens are contiguous (matching the KV-cache
+    slot layout the decode loop produced).
+    """
+    B, P = prompt_ids.shape
+    T = completions.shape[1]
+    seq = jnp.zeros((B, P + T), prompt_ids.dtype)
+    seq = seq.at[:, :P].set(prompt_ids)
+    return jax.vmap(
+        lambda s, c, l: jax.lax.dynamic_update_slice(s, c, (l,))
+    )(seq, completions, prompt_lens)

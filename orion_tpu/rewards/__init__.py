@@ -1,0 +1,5 @@
+from orion_tpu.rewards.reward_model import ModelReward  # noqa: F401
+from orion_tpu.rewards.math_verifier import (  # noqa: F401
+    MathVerifierReward,
+    extract_last_number,
+)

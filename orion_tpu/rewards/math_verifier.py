@@ -1,0 +1,86 @@
+"""Rule-based math reward (SPEC config 5): a host-side verifier over
+generated text — no reward model anywhere (SURVEY.md §2 #4, §3d).
+
+The verifier extracts the final numeric answer from each completion and
+compares it to the gold answer in the batch metadata.  Host-side pure
+Python is the idiomatic place for this: it runs while the TPU generates
+the next batch, off the XLA hot path.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Callable, Optional
+
+import numpy as np
+
+from orion_tpu.rollout import GenerationResult
+
+_NUM_RE = re.compile(r"-?\d+(?:\.\d+)?(?:/\d+)?")
+_BOXED_RE = re.compile(r"\\boxed\{([^{}]*)\}")
+_HASH_RE = re.compile(r"####\s*([^\n]+)")
+
+
+def _to_float(s: str) -> Optional[float]:
+    s = s.strip().replace(",", "").replace("$", "").rstrip(".")
+    try:
+        if "/" in s:
+            num, den = s.split("/", 1)
+            return float(num) / float(den)
+        return float(s)
+    except (ValueError, ZeroDivisionError):
+        return None
+
+
+def extract_last_number(text: str) -> Optional[float]:
+    """GSM8K/MATH-style answer extraction: prefer '#### x', then
+    \\boxed{x}, else the last number in the text."""
+    text = re.sub(r"(?<=\d),(?=\d)", "", text)  # 1,234.5 -> 1234.5
+    m = _HASH_RE.search(text)
+    if m:
+        got = _to_float(m.group(1))
+        if got is not None:
+            return got
+    m = _BOXED_RE.findall(text)
+    if m:
+        got = _to_float(m[-1])
+        if got is not None:
+            return got
+    nums = _NUM_RE.findall(text)
+    return _to_float(nums[-1]) if nums else None
+
+
+class MathVerifierReward:
+    """reward_fn: 1.0 if the extracted answer matches meta['answer'].
+
+    decode_fn maps a list of token-id lists → list of strings (a
+    tokenizer's batch_decode).  ``extract`` is pluggable for other
+    verifiable-reward tasks.
+    """
+
+    def __init__(self, decode_fn: Callable, answer_key: str = "answer",
+                 extract: Callable = extract_last_number,
+                 correct: float = 1.0, incorrect: float = 0.0,
+                 tol: float = 1e-6):
+        self.decode_fn = decode_fn
+        self.answer_key = answer_key
+        self.extract = extract
+        self.correct = correct
+        self.incorrect = incorrect
+        self.tol = tol
+
+    def __call__(self, result: GenerationResult, meta: dict) -> np.ndarray:
+        comps = np.asarray(result.completions)
+        lens = np.asarray(result.completion_lens)
+        texts = self.decode_fn(
+            [comps[i, :lens[i]].tolist() for i in range(len(comps))])
+        gold = meta[self.answer_key]
+        out = np.full(len(texts), self.incorrect, np.float32)
+        for i, text in enumerate(texts):
+            got = self.extract(text)
+            g = gold[i] if not isinstance(gold[i], (bytes, np.bytes_)) \
+                else gold[i].decode()
+            g = _to_float(str(g))
+            if got is not None and g is not None and abs(got - g) <= self.tol:
+                out[i] = self.correct
+        return out

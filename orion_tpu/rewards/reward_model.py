@@ -1,0 +1,32 @@
+"""Model-based sequence scoring (SURVEY.md §2 #6): a ScalarHeadModel
+forward pass as a pure XLA program, reading the value at the last real
+token.  Used as the ``reward_fn`` of any trainer."""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from orion_tpu.models.heads import ScalarHeadModel, score_last_token
+from orion_tpu.rollout import GenerationResult
+
+
+class ModelReward:
+    def __init__(self, model: ScalarHeadModel, params: Any):
+        self.model = model
+        self.params = params
+
+        @jax.jit
+        def _score(params, sequences, total_lens):
+            positions = jnp.broadcast_to(
+                jnp.arange(sequences.shape[1], dtype=jnp.int32),
+                sequences.shape)
+            values = self.model.apply({"params": params}, sequences, positions)
+            return score_last_token(values, total_lens)
+
+        self._score = _score
+
+    def __call__(self, result: GenerationResult, meta: dict) -> jnp.ndarray:
+        return self._score(self.params, result.sequences, result.total_lens)

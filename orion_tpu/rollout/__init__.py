@@ -1,0 +1,1 @@
+from orion_tpu.rollout.engine import RolloutEngine, GenerationResult  # noqa: F401

@@ -1,0 +1,149 @@
+"""The rollout engine — TPU-native equivalent of the reference's vLLM
+generation engine (SURVEY.md §2 #5, §3c).
+
+Design (XLA-first, static shapes):
+- one jitted program per (batch, prompt_len, max_new_tokens) bucket:
+  prefill (full-seq forward filling the KV cache) then a
+  ``lax.while_loop`` decode with per-sequence EOS early exit — the loop
+  terminates as soon as every sequence is done, so wall-clock tracks the
+  longest completion, not the static bound;
+- per-token logprobs captured in f32 under the *actual* sampling
+  distribution (temperature/top-k/top-p applied);
+- ``load_weights`` is the weight hot-reload channel the trainer calls
+  between steps (in async mode the weight-sync channel lands here);
+- right-padded prompts with per-sequence lengths; the cache write path
+  overwrites the padded tail slot-by-slot during decode (see
+  models.transformer.Attention).
+
+The paged-KV upgrade (block tables + Pallas paged attention) slots in
+behind the same interface via RolloutConfig.paged.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from orion_tpu.config import ModelConfig, RolloutConfig
+from orion_tpu.models.transformer import init_cache
+from orion_tpu.ops.logprobs import pack_sequences
+from orion_tpu.ops.sampling import sample_tokens
+
+
+@dataclasses.dataclass
+class GenerationResult:
+    """Everything downstream consumers (scoring, trainers) need."""
+
+    sequences: jnp.ndarray        # [B, P+T] packed prompt+completion
+    completions: jnp.ndarray      # [B, T] completion tokens (pad after EOS)
+    completion_mask: jnp.ndarray  # [B, T] 1.0 for real completion tokens
+    completion_lens: jnp.ndarray  # [B] number of real completion tokens
+    logprobs: jnp.ndarray         # [B, T] f32 behavioral-policy logprobs
+    prompt_lens: jnp.ndarray      # [B]
+    total_lens: jnp.ndarray       # [B] prompt + completion lengths
+
+
+class RolloutEngine:
+    """Batched autoregressive generation with KV cache + logprob capture."""
+
+    def __init__(self, model: Any, model_cfg: ModelConfig,
+                 cfg: RolloutConfig, eos_token_id: Optional[int] = None,
+                 pad_token_id: int = 0):
+        self.model = model
+        self.model_cfg = model_cfg
+        self.cfg = cfg
+        self.eos_token_id = eos_token_id
+        self.pad_token_id = pad_token_id
+        self._params = None
+        self._generate_jit = jax.jit(
+            self._generate, static_argnames=("max_new_tokens",))
+
+    # -- weight hot-reload channel (trainer → rollout) ------------------
+    def load_weights(self, params: Any) -> None:
+        """Install new policy weights.  In sync mode this is a reference
+        swap (zero copy — the arrays already live on the mesh); in async
+        mode the weight-sync channel device_puts a fresh snapshot here
+        (SURVEY.md §2 #11)."""
+        self._params = params
+
+    # -- generation -----------------------------------------------------
+    def generate(self, prompt_ids: jnp.ndarray, prompt_lens: jnp.ndarray,
+                 rng: jax.Array, params: Any = None,
+                 max_new_tokens: Optional[int] = None) -> GenerationResult:
+        params = params if params is not None else self._params
+        if params is None:
+            raise ValueError("no weights loaded: call load_weights() first")
+        T = int(max_new_tokens or self.cfg.max_new_tokens)
+        out = self._generate_jit(params, prompt_ids, prompt_lens, rng,
+                                 max_new_tokens=T)
+        return GenerationResult(**out)
+
+    def _generate(self, params, prompt_ids, prompt_lens, rng,
+                  max_new_tokens: int):
+        cfg = self.cfg
+        B, P = prompt_ids.shape
+        T = max_new_tokens
+        eos = self.eos_token_id
+        pad = self.pad_token_id
+        sample = partial(sample_tokens, temperature=cfg.temperature,
+                         top_k=cfg.top_k, top_p=cfg.top_p)
+
+        cache = init_cache(self.model_cfg, B, P + T,
+                           dtype=jnp.dtype(self.model_cfg.dtype))
+        positions = jnp.broadcast_to(jnp.arange(P, dtype=jnp.int32), (B, P))
+        logits, cache = self.model.apply(
+            {"params": params}, prompt_ids, positions, cache)
+
+        # logits at the last real prompt token predict completion[0]
+        last = jnp.take_along_axis(
+            logits, (prompt_lens - 1)[:, None, None], axis=1)[:, 0]
+        rng, sub = jax.random.split(rng)
+        tok0, lp0 = sample(sub, last)
+
+        tokens = jnp.full((B, T), pad, jnp.int32).at[:, 0].set(tok0)
+        logps = jnp.zeros((B, T), jnp.float32).at[:, 0].set(lp0)
+        done = jnp.zeros((B,), bool) if eos is None else (tok0 == eos)
+        comp_len = jnp.ones((B,), jnp.int32)
+
+        def cond(c):
+            t, _, _, _, done, _, _, _ = c
+            return (t < T) & ~jnp.all(done)
+
+        def body(c):
+            t, cur_tok, cur_pos, rng, done, tokens, logps, state = c
+            cache, comp_len = state
+            step_logits, cache = self.model.apply(
+                {"params": params}, cur_tok[:, None], cur_pos[:, None],
+                cache)
+            rng, sub = jax.random.split(rng)
+            nxt, lp = sample(sub, step_logits[:, 0])
+            nxt = jnp.where(done, pad, nxt)
+            lp = jnp.where(done, 0.0, lp)
+            tokens = tokens.at[:, t].set(nxt, mode="drop")
+            logps = logps.at[:, t].set(lp, mode="drop")
+            comp_len = comp_len + (~done).astype(jnp.int32)
+            if eos is not None:
+                done = done | (nxt == eos)
+            return (t + 1, nxt, cur_pos + 1, rng, done, tokens, logps,
+                    (cache, comp_len))
+
+        init = (jnp.int32(1), tok0, prompt_lens, rng, done, tokens, logps,
+                (cache, comp_len))
+        _, _, _, _, done, tokens, logps, (cache, comp_len) = \
+            jax.lax.while_loop(cond, body, init)
+
+        mask = (jnp.arange(T)[None, :] < comp_len[:, None]).astype(jnp.float32)
+        sequences = pack_sequences(prompt_ids, prompt_lens, tokens)
+        return dict(
+            sequences=sequences,
+            completions=tokens,
+            completion_mask=mask,
+            completion_lens=comp_len,
+            logprobs=logps,
+            prompt_lens=prompt_lens,
+            total_lens=prompt_lens + comp_len,
+        )

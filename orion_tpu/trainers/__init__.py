@@ -1,0 +1,5 @@
+from orion_tpu.trainers.base import BaseTrainer, TrainState, make_optimizer  # noqa: F401
+from orion_tpu.trainers.grpo import GRPOTrainer  # noqa: F401
+from orion_tpu.trainers.ppo import PPOTrainer  # noqa: F401
+from orion_tpu.trainers.rloo import RLOOTrainer  # noqa: F401
+from orion_tpu.trainers.online_dpo import OnlineDPOTrainer  # noqa: F401

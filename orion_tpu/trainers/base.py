@@ -1,0 +1,221 @@
+"""Trainer base: optimizer construction, train state, the experience
+pipeline skeleton, and the sync weight-sync channel.
+
+Control flow contract (SURVEY.md §3a): each iteration is
+  prompts → rollout.generate → score → advantages → minibatch updates
+  → weight-sync → metrics.
+Algorithm subclasses implement ``make_experience`` (pipeline front half)
+and ``loss_fn`` (pure jittable loss over a minibatch); the base class
+owns generation, minibatching, the jitted update step, and logging.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Iterator, Optional
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from orion_tpu.config import OptimizerConfig, TrainConfig
+from orion_tpu.models.transformer import Transformer
+from orion_tpu.ops.logprobs import completion_logprobs, entropy_from_logits
+from orion_tpu.rollout import GenerationResult, RolloutEngine
+
+
+@flax.struct.dataclass
+class TrainState:
+    params: Any
+    opt_state: Any
+    step: jnp.ndarray
+
+    @staticmethod
+    def create(params: Any, tx: optax.GradientTransformation) -> "TrainState":
+        return TrainState(params=params, opt_state=tx.init(params),
+                          step=jnp.zeros((), jnp.int32))
+
+
+def make_schedule(cfg: OptimizerConfig):
+    base = cfg.learning_rate
+    if cfg.schedule == "constant" and cfg.warmup_steps == 0:
+        return base
+    if cfg.schedule != "constant" and cfg.total_steps <= 0:
+        raise ValueError(
+            f"schedule={cfg.schedule!r} needs optimizer.total_steps > 0 "
+            "(the decay horizon); total_steps=0 only works with 'constant'")
+    warmup = optax.linear_schedule(0.0, base, max(cfg.warmup_steps, 1))
+    rest_steps = max(cfg.total_steps - cfg.warmup_steps, 1)
+    if cfg.schedule == "cosine":
+        rest = optax.cosine_decay_schedule(base, rest_steps)
+    elif cfg.schedule == "linear":
+        rest = optax.linear_schedule(base, 0.0, rest_steps)
+    else:
+        rest = optax.constant_schedule(base)
+    return optax.join_schedules([warmup, rest], [cfg.warmup_steps])
+
+
+def make_optimizer(cfg: OptimizerConfig) -> optax.GradientTransformation:
+    tx = optax.adamw(make_schedule(cfg), b1=cfg.betas[0], b2=cfg.betas[1],
+                     eps=cfg.eps, weight_decay=cfg.weight_decay)
+    if cfg.grad_clip > 0:
+        tx = optax.chain(optax.clip_by_global_norm(cfg.grad_clip), tx)
+    return tx
+
+
+class BaseTrainer:
+    """Shared machinery; see PPOTrainer/GRPOTrainer/... for algorithms.
+
+    Args:
+      cfg: algorithm config (TrainConfig subclass).
+      model: the policy Transformer (also used for ref logprobs).
+      params: policy params (on-mesh or host; used as-is).
+      ref_params: frozen reference policy params (None => snapshot of
+        ``params`` at construction — the standard init-KL anchoring).
+      reward_fn: host callable (GenerationResult, batch_meta) -> np [B]
+        sequence scores.  Model-based rewards wrap ModelReward.
+      eos/pad token ids: generation termination.
+    """
+
+    needs_ref = True
+
+    def __init__(self, cfg: TrainConfig, model: Transformer, params: Any,
+                 reward_fn: Optional[Callable] = None,
+                 ref_params: Any = None,
+                 eos_token_id: Optional[int] = None, pad_token_id: int = 0):
+        self.cfg = cfg
+        self.model = model
+        self.tx = make_optimizer(cfg.optimizer)
+        self.state = TrainState.create(params, self.tx)
+        self.reward_fn = reward_fn
+        if self.needs_ref:
+            # Real buffer copy: the update step donates the policy params,
+            # so an aliasing snapshot would be invalidated.
+            self.ref_params = ref_params if ref_params is not None else \
+                jax.tree.map(jnp.copy, params)
+        else:
+            self.ref_params = None
+        self.engine = RolloutEngine(model, cfg.model, cfg.rollout,
+                                    eos_token_id=eos_token_id,
+                                    pad_token_id=pad_token_id)
+        self.engine.load_weights(params)
+        self.metrics_history: list = []
+        self._rng = jax.random.key(cfg.seed)
+        self._np_rng = np.random.RandomState(cfg.seed)
+        self._jit_logprobs = jax.jit(
+            self._logprobs_fn, static_argnames=("max_new",))
+        self._jit_update = jax.jit(self._update_fn, donate_argnums=(0,))
+
+    # ------------------------------------------------------------------
+    # jitted helpers
+    # ------------------------------------------------------------------
+    def _logprobs_fn(self, params, sequences, prompt_lens, max_new: int):
+        """Completion logprobs + entropy under the training graph."""
+        positions = jnp.broadcast_to(
+            jnp.arange(sequences.shape[1], dtype=jnp.int32), sequences.shape)
+        logits, _ = self.model.apply({"params": params}, sequences, positions)
+        lp = completion_logprobs(logits, sequences, prompt_lens, max_new)
+        ent = entropy_from_logits(logits)
+        idx = jnp.clip(
+            prompt_lens[:, None] + jnp.arange(max_new)[None, :] - 1,
+            0, logits.shape[1] - 1)
+        return lp, jnp.take_along_axis(ent, idx, axis=1)
+
+    def loss_fn(self, params, mb: Dict[str, jnp.ndarray]):
+        raise NotImplementedError
+
+    def _update_fn(self, state: TrainState, experience, idx):
+        mb = jax.tree.map(lambda x: jnp.take(x, idx, axis=0), experience)
+        (loss, stats), grads = jax.value_and_grad(
+            self.loss_fn, has_aux=True)(state.params, mb)
+        updates, opt_state = self.tx.update(grads, state.opt_state,
+                                            state.params)
+        params = optax.apply_updates(state.params, updates)
+        stats = dict(stats)
+        stats["grad_norm"] = optax.global_norm(grads)
+        stats["loss"] = loss
+        return TrainState(params=params, opt_state=opt_state,
+                          step=state.step + 1), stats
+
+    # ------------------------------------------------------------------
+    # experience pipeline
+    # ------------------------------------------------------------------
+    def next_rng(self) -> jax.Array:
+        self._rng, sub = jax.random.split(self._rng)
+        return sub
+
+    def generate(self, prompt_ids, prompt_lens) -> GenerationResult:
+        return self.engine.generate(
+            jnp.asarray(prompt_ids), jnp.asarray(prompt_lens),
+            self.next_rng(), params=self.state.params)
+
+    def score(self, result: GenerationResult, batch: dict) -> jnp.ndarray:
+        """Sequence-level scores [B] (f32, on host or device)."""
+        if self.reward_fn is None:
+            raise ValueError("no reward_fn configured")
+        scores = self.reward_fn(result, batch)
+        return jnp.asarray(np.asarray(scores), jnp.float32)
+
+    def make_experience(self, batch: dict) -> Dict[str, jnp.ndarray]:
+        raise NotImplementedError
+
+    def _apply_update(self, experience, idx) -> dict:
+        """One minibatch step.  Subclasses with extra train states (PPO's
+        critic) override this hook; the epoch loop stays in one place."""
+        self.state, stats = self._jit_update(self.state, experience, idx)
+        return stats
+
+    def update_epochs(self, experience: Dict[str, jnp.ndarray]) -> dict:
+        """num_epochs passes of shuffled minibatches (hot loop #2)."""
+        B = int(experience["prompt_lens"].shape[0])
+        mb = self.cfg.minibatch_size
+        assert B % mb == 0, f"batch {B} not divisible by minibatch {mb}"
+        agg: Dict[str, list] = {}
+        for _ in range(self.cfg.num_epochs):
+            perm = self._np_rng.permutation(B)
+            for i in range(0, B, mb):
+                idx = jnp.asarray(perm[i:i + mb])
+                stats = self._apply_update(experience, idx)
+                for k, v in stats.items():
+                    agg.setdefault(k, []).append(float(v))
+        return {k: float(np.mean(v)) for k, v in agg.items()}
+
+    def sync_weights(self) -> None:
+        """Trainer → rollout weight sync (SURVEY.md §2 #11).  Sync mode:
+        the engine shares the mesh, so this is a reference swap; the
+        async orchestrator overrides this with the ICI broadcast."""
+        self.engine.load_weights(self.state.params)
+
+    # ------------------------------------------------------------------
+    def train(self, prompt_iter: Iterator[dict],
+              num_iterations: Optional[int] = None) -> list:
+        """The outer loop (SURVEY.md §3a)."""
+        import time
+
+        n = num_iterations or self.cfg.total_iterations
+        for it in range(n):
+            t0 = time.perf_counter()
+            batch = next(prompt_iter)
+            experience, exp_stats = self.make_experience(batch)
+            t1 = time.perf_counter()
+            stats = self.update_epochs(experience)
+            self.sync_weights()
+            t2 = time.perf_counter()
+            stats.update(exp_stats)
+            n_samples = int(experience["prompt_lens"].shape[0])
+            stats.update({
+                "iteration": it,
+                "time_rollout_s": t1 - t0,
+                "time_update_s": t2 - t1,
+                "samples_per_sec": n_samples / (t2 - t0),
+            })
+            self.metrics_history.append(stats)
+            if self.cfg.log_every and it % self.cfg.log_every == 0:
+                self.log(stats)
+        return self.metrics_history
+
+    def log(self, stats: dict) -> None:
+        keys = ("iteration", "reward_mean", "loss", "kl", "samples_per_sec")
+        msg = " ".join(f"{k}={stats[k]:.4g}" for k in keys if k in stats)
+        print(f"[orion-tpu] {msg}", flush=True)

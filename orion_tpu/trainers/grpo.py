@@ -1,0 +1,80 @@
+"""GRPO trainer (SPEC config 5): group-relative advantages, rule-based
+rewards, no critic, no reward model (SURVEY.md §2 #4, §3d).
+
+Pipeline per iteration: repeat each prompt ``group_size`` times →
+generate → host-side verifier scores → group-normalized advantages →
+clipped-ratio policy update with explicit KL(policy ‖ ref) penalty in
+the loss (k3 estimator).
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax.numpy as jnp
+import numpy as np
+
+from orion_tpu.algos import (grpo_advantages, kl_penalty, masked_mean,
+                             ppo_policy_loss)
+from orion_tpu.config import GRPOConfig
+from orion_tpu.trainers.base import BaseTrainer
+
+
+class GRPOTrainer(BaseTrainer):
+    cfg: GRPOConfig
+
+    def make_experience(self, batch: dict):
+        k = self.cfg.group_size
+        prompt_ids = np.repeat(np.asarray(batch["prompt_ids"]), k, axis=0)
+        prompt_lens = np.repeat(np.asarray(batch["prompt_lens"]), k, axis=0)
+        meta = {key: np.repeat(np.asarray(v), k, axis=0)
+                for key, v in batch.items()
+                if key not in ("prompt_ids", "prompt_lens")}
+
+        result = self.generate(prompt_ids, prompt_lens)
+        scores = self.score(result, meta)
+
+        T = result.completions.shape[1]
+        # Old logprobs are recomputed under the *training* graph (not the
+        # engine's sampling distribution, which bakes in temperature /
+        # top-k/p) so the clipped ratio is exactly 1 on the first epoch.
+        old_lp, _ = self._jit_logprobs(
+            self.state.params, result.sequences, result.prompt_lens,
+            max_new=T)
+        ref_lp, _ = self._jit_logprobs(
+            self.ref_params, result.sequences, result.prompt_lens, max_new=T)
+
+        adv_seq = grpo_advantages(
+            scores, k, normalize_std=(self.cfg.variant == "grpo"))
+        experience = {
+            "sequences": result.sequences,
+            "prompt_lens": result.prompt_lens,
+            "mask": result.completion_mask,
+            "old_logprobs": old_lp * result.completion_mask,
+            # ref_logprobs stay unmasked: the k3 estimator exponentiates
+            # (ref - lp), and a zeroed ref at pad positions would
+            # overflow exp() before the mask can zero the product.
+            "ref_logprobs": ref_lp,
+            "advantages": adv_seq[:, None] * result.completion_mask,
+        }
+        stats = {
+            "reward_mean": float(jnp.mean(scores)),
+            "reward_std": float(jnp.std(scores)),
+            "completion_len_mean": float(jnp.mean(result.completion_lens)),
+        }
+        return experience, stats
+
+    def loss_fn(self, params, mb: Dict[str, jnp.ndarray]):
+        T = mb["mask"].shape[1]
+        lp, ent = self._logprobs_fn(
+            params, mb["sequences"], mb["prompt_lens"], max_new=T)
+        pg_loss, stats = ppo_policy_loss(
+            lp, mb["old_logprobs"], mb["advantages"], mb["mask"],
+            self.cfg.clip_ratio)
+        kl = kl_penalty(lp, mb["ref_logprobs"], "k3") * mb["mask"]
+        kl_mean = masked_mean(kl, mb["mask"])
+        loss = pg_loss + self.cfg.kl_coef * kl_mean
+        stats = dict(stats)
+        stats["kl"] = kl_mean
+        stats["entropy"] = masked_mean(ent, mb["mask"])
+        return loss, stats

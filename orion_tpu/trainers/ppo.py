@@ -1,0 +1,159 @@
+"""PPO trainer (SPEC configs 1-2): clipped policy loss + clipped value
+loss, GAE advantages, per-token KL-shaped rewards, adaptive KL
+controller (SURVEY.md §2 #1, §3a).
+
+The critic is a separate ScalarHeadModel with its own TrainState; policy
+and critic update in one jitted step (two backward passes, one XLA
+program — the TPU analogue of the reference's joint actor/critic step).
+Old logprobs are recomputed under the *training* graph right after
+generation so the importance ratio is exactly 1 on the first epoch
+(eliminating sampler/trainer drift from the objective).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from orion_tpu.algos import (AdaptiveKLController, FixedKLController, gae,
+                             kl_penalty, masked_mean, masked_whiten,
+                             per_token_rewards, ppo_policy_loss,
+                             ppo_value_loss)
+from orion_tpu.config import PPOConfig
+from orion_tpu.models.heads import ScalarHeadModel
+from orion_tpu.trainers.base import BaseTrainer, TrainState
+
+
+class PPOTrainer(BaseTrainer):
+    cfg: PPOConfig
+
+    def __init__(self, cfg: PPOConfig, model, params,
+                 critic_model: ScalarHeadModel, critic_params: Any,
+                 **kw):
+        super().__init__(cfg, model, params, **kw)
+        self.critic_model = critic_model
+        self.critic_state = TrainState.create(critic_params, self.tx)
+        self.kl_ctl = (AdaptiveKLController(cfg.kl_coef, cfg.kl_target,
+                                            cfg.kl_horizon)
+                       if cfg.adaptive_kl else FixedKLController(cfg.kl_coef))
+
+        self._jit_values = jax.jit(self._values_fwd)
+        self._jit_ppo_update = jax.jit(self._ppo_update_fn,
+                                       donate_argnums=(0, 1))
+
+    def _values_fwd(self, critic_params, sequences, prompt_lens, mask):
+        """Per-completion-token values: the value for completion token t
+        reads the hidden state at the previous token — the same
+        alignment as completion_logprobs (single source of truth for
+        the classic off-by-one bug class, SURVEY.md §4)."""
+        positions = jnp.broadcast_to(
+            jnp.arange(sequences.shape[1], dtype=jnp.int32),
+            sequences.shape)
+        values = self.critic_model.apply(
+            {"params": critic_params}, sequences, positions)
+        T = mask.shape[1]
+        idx = jnp.clip(
+            prompt_lens[:, None] + jnp.arange(T)[None, :] - 1,
+            0, values.shape[1] - 1)
+        return jnp.take_along_axis(values, idx, axis=1) * mask
+
+    # ------------------------------------------------------------------
+    def make_experience(self, batch: dict):
+        result = self.generate(batch["prompt_ids"], batch["prompt_lens"])
+        meta = {k: v for k, v in batch.items()
+                if k not in ("prompt_ids", "prompt_lens")}
+        scores = self.score(result, meta)
+
+        T = result.completions.shape[1]
+        mask = result.completion_mask
+        old_lp, _ = self._jit_logprobs(
+            self.state.params, result.sequences, result.prompt_lens,
+            max_new=T)
+        ref_lp, _ = self._jit_logprobs(
+            self.ref_params, result.sequences, result.prompt_lens, max_new=T)
+        values = self._jit_values(
+            self.critic_state.params, result.sequences, result.prompt_lens,
+            mask)
+
+        kl = kl_penalty(old_lp, ref_lp, "k1") * mask
+        rewards = per_token_rewards(scores, kl, mask, self.kl_ctl.value,
+                                    self.cfg.reward_clip)
+        advantages, returns = gae(rewards, values, mask,
+                                  self.cfg.gamma, self.cfg.gae_lambda)
+        if self.cfg.whiten_advantages:
+            advantages = masked_whiten(advantages, mask)
+
+        mean_kl = float(masked_mean(kl, mask))
+        self.kl_ctl.update(mean_kl, int(mask.shape[0]))
+
+        experience = {
+            "sequences": result.sequences,
+            "prompt_lens": result.prompt_lens,
+            "mask": mask,
+            "old_logprobs": old_lp * mask,
+            "old_values": values,
+            "advantages": advantages,
+            "returns": returns,
+        }
+        stats = {
+            "reward_mean": float(jnp.mean(scores)),
+            "reward_std": float(jnp.std(scores)),
+            "kl": mean_kl,
+            "kl_coef": self.kl_ctl.value,
+            "value_mean": float(masked_mean(values, mask)),
+            "return_mean": float(masked_mean(returns, mask)),
+            "completion_len_mean": float(jnp.mean(result.completion_lens)),
+        }
+        return experience, stats
+
+    # ------------------------------------------------------------------
+    def _policy_loss(self, params, mb):
+        T = mb["mask"].shape[1]
+        lp, ent = self._logprobs_fn(
+            params, mb["sequences"], mb["prompt_lens"], max_new=T)
+        loss, stats = ppo_policy_loss(
+            lp, mb["old_logprobs"], mb["advantages"], mb["mask"],
+            self.cfg.clip_ratio)
+        stats = dict(stats)
+        stats["entropy"] = masked_mean(ent, mb["mask"])
+        return loss, stats
+
+    def _value_loss(self, critic_params, mb):
+        values = self._values_fwd(critic_params, mb["sequences"],
+                                  mb["prompt_lens"], mb["mask"])
+        loss, stats = ppo_value_loss(
+            values, mb["old_values"], mb["returns"], mb["mask"],
+            self.cfg.value_clip)
+        return self.cfg.vf_coef * loss, stats
+
+    def _ppo_update_fn(self, state: TrainState, critic_state: TrainState,
+                       experience, idx):
+        mb = jax.tree.map(lambda x: jnp.take(x, idx, axis=0), experience)
+        (p_loss, p_stats), p_grads = jax.value_and_grad(
+            self._policy_loss, has_aux=True)(state.params, mb)
+        (v_loss, v_stats), v_grads = jax.value_and_grad(
+            self._value_loss, has_aux=True)(critic_state.params, mb)
+
+        p_updates, p_opt = self.tx.update(p_grads, state.opt_state,
+                                          state.params)
+        new_state = TrainState(
+            params=optax.apply_updates(state.params, p_updates),
+            opt_state=p_opt, step=state.step + 1)
+        v_updates, v_opt = self.tx.update(v_grads, critic_state.opt_state,
+                                          critic_state.params)
+        new_critic = TrainState(
+            params=optax.apply_updates(critic_state.params, v_updates),
+            opt_state=v_opt, step=critic_state.step + 1)
+
+        stats = {**p_stats, **v_stats}
+        stats["loss"] = p_loss + v_loss
+        stats["grad_norm"] = optax.global_norm(p_grads)
+        return new_state, new_critic, stats
+
+    def _apply_update(self, experience, idx) -> dict:
+        self.state, self.critic_state, stats = self._jit_ppo_update(
+            self.state, self.critic_state, experience, idx)
+        return stats

@@ -1,0 +1,152 @@
+"""Golden-value tests: every loss/advantage fn vs hand-computed numpy
+(SURVEY.md §4 "Numerics")."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from orion_tpu.algos import (
+    AdaptiveKLController, gae, grpo_advantages, kl_penalty, masked_mean,
+    masked_whiten, per_token_rewards, ppo_policy_loss, ppo_value_loss,
+    dpo_loss, reinforce_loss, rloo_advantages)
+
+
+def _np_gae(rewards, values, mask, gamma, lam):
+    B, T = rewards.shape
+    adv = np.zeros((B, T))
+    for b in range(B):
+        last = 0.0
+        for t in reversed(range(T)):
+            next_v = values[b, t + 1] if t + 1 < T and mask[b, t + 1] else 0.0
+            next_m = mask[b, t + 1] if t + 1 < T else 0.0
+            delta = rewards[b, t] + gamma * next_v - values[b, t]
+            last = delta + gamma * lam * last * next_m
+            adv[b, t] = last * mask[b, t]
+    return adv
+
+
+def test_gae_golden():
+    rng = np.random.RandomState(0)
+    B, T = 3, 6
+    rewards = rng.randn(B, T).astype(np.float32)
+    values = rng.randn(B, T).astype(np.float32)
+    mask = np.ones((B, T), np.float32)
+    mask[0, 4:] = 0  # ragged sequence
+    mask[2, 2:] = 0
+    rewards, values = rewards * mask, values * mask
+    adv, ret = gae(jnp.asarray(rewards), jnp.asarray(values),
+                   jnp.asarray(mask), gamma=0.98, lam=0.9)
+    ref = _np_gae(rewards, values, mask, 0.98, 0.9)
+    np.testing.assert_allclose(np.asarray(adv), ref, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(ret), ref + values * mask,
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_gae_gamma1_lambda1_is_reward_to_go():
+    # with gamma=lam=1, returns = suffix sums of rewards
+    rewards = np.array([[1.0, 2.0, 3.0]], np.float32)
+    values = np.zeros((1, 3), np.float32)
+    mask = np.ones((1, 3), np.float32)
+    adv, ret = gae(jnp.asarray(rewards), jnp.asarray(values),
+                   jnp.asarray(mask), 1.0, 1.0)
+    np.testing.assert_allclose(np.asarray(ret), [[6.0, 5.0, 3.0]])
+
+
+def test_rloo_golden():
+    scores = jnp.array([1.0, 2.0, 3.0, 10.0, 20.0, 30.0])
+    adv = rloo_advantages(scores, 3)
+    # group1: baselines (2.5, 2, 1.5) -> adv (-1.5, 0, 1.5)
+    np.testing.assert_allclose(
+        np.asarray(adv), [-1.5, 0.0, 1.5, -15.0, 0.0, 15.0])
+
+
+def test_grpo_golden():
+    scores = jnp.array([0.0, 1.0, 0.0, 1.0])
+    adv = grpo_advantages(scores, 2)
+    np.testing.assert_allclose(np.asarray(adv), [-1.0, 1.0, -1.0, 1.0],
+                               atol=1e-3)
+    adv_nostd = grpo_advantages(scores, 2, normalize_std=False)
+    np.testing.assert_allclose(np.asarray(adv_nostd), [-0.5, 0.5, -0.5, 0.5])
+
+
+def test_per_token_rewards_placement():
+    scores = jnp.array([5.0, -20.0])
+    kl = jnp.ones((2, 4))
+    mask = jnp.array([[1, 1, 1, 0], [1, 1, 1, 1]], jnp.float32)
+    r = per_token_rewards(scores, kl, mask, kl_coef=0.1, reward_clip=10.0)
+    np.testing.assert_allclose(
+        np.asarray(r),
+        [[-0.1, -0.1, 4.9, 0.0],  # score at token 2 (last real)
+         [-0.1, -0.1, -0.1, -10.1]],  # clipped to -10, at token 3
+        rtol=1e-6)
+
+
+def test_ppo_policy_loss_golden():
+    lp = jnp.array([[0.0, -1.0]])
+    old = jnp.array([[0.0, 0.0]])
+    adv = jnp.array([[1.0, 1.0]])
+    mask = jnp.ones((1, 2))
+    loss, stats = ppo_policy_loss(lp, old, adv, mask, clip_ratio=0.2)
+    # tok0: ratio 1 -> -1; tok1: ratio e^-1≈.368 clipped to .8 -> max(-.368, -.8) = -.368
+    expected = (-1.0 + -np.exp(-1.0)) / 2
+    np.testing.assert_allclose(float(loss), expected, rtol=1e-5)
+    assert float(stats["clip_frac"]) == 0.5
+
+
+def test_ppo_value_loss_golden():
+    v = jnp.array([[2.0]])
+    old_v = jnp.array([[0.0]])
+    ret = jnp.array([[0.5]])
+    mask = jnp.ones((1, 1))
+    loss, _ = ppo_value_loss(v, old_v, ret, mask, value_clip=0.2)
+    # clipped v = 0.2; sq=(2-.5)^2=2.25, sq_clip=(0.2-0.5)^2=0.09 -> max 2.25
+    np.testing.assert_allclose(float(loss), 0.5 * 2.25, rtol=1e-6)
+
+
+def test_dpo_loss_golden():
+    loss, stats = dpo_loss(
+        jnp.array([-1.0]), jnp.array([-2.0]),
+        jnp.array([-1.5]), jnp.array([-1.5]), beta=0.5)
+    logits = 0.5 * ((-1.0 + 1.5) - (-2.0 + 1.5))
+    np.testing.assert_allclose(float(loss), -np.log(1 / (1 + np.exp(-logits))),
+                               rtol=1e-5)
+    assert float(stats["accuracy"]) == 1.0
+
+
+def test_reinforce_loss_golden():
+    lp = jnp.array([[-1.0, -2.0]])
+    adv = jnp.array([[2.0, 2.0]])
+    mask = jnp.array([[1.0, 0.0]])
+    loss, _ = reinforce_loss(lp, adv, mask)
+    np.testing.assert_allclose(float(loss), 2.0)  # -2*-1 masked-mean over 1 tok
+
+
+def test_kl_estimators():
+    lp = jnp.array([0.0, -1.0])
+    ref = jnp.array([-0.5, -0.5])
+    np.testing.assert_allclose(np.asarray(kl_penalty(lp, ref, "k1")),
+                               [0.5, -0.5])
+    np.testing.assert_allclose(np.asarray(kl_penalty(lp, ref, "k2")),
+                               [0.125, 0.125])
+    k3 = np.exp(np.array([-0.5, 0.5])) - 1 + np.array([0.5, -0.5])
+    np.testing.assert_allclose(np.asarray(kl_penalty(lp, ref, "k3")), k3,
+                               rtol=1e-6)
+    assert (np.asarray(kl_penalty(lp, ref, "k3")) >= 0).all()
+    with pytest.raises(ValueError):
+        kl_penalty(lp, ref, "k9")
+
+
+def test_adaptive_kl_controller():
+    c = AdaptiveKLController(0.1, target=6.0, horizon=100)
+    c.update(12.0, 10)  # err clipped to +0.2 -> coef *= 1.02
+    np.testing.assert_allclose(c.value, 0.102)
+    c.update(0.0, 10)  # err clipped to -0.2
+    np.testing.assert_allclose(c.value, 0.102 * 0.98)
+
+
+def test_masked_whiten():
+    x = jnp.array([[1.0, 2.0, 3.0, 99.0]])
+    mask = jnp.array([[1.0, 1.0, 1.0, 0.0]])
+    w = masked_whiten(x, mask)
+    assert abs(float(masked_mean(w, mask))) < 1e-6
+    assert float(w[0, 3]) == 0.0

@@ -1,0 +1,56 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from orion_tpu.config import ModelConfig
+from orion_tpu.models import ScalarHeadModel, init_scalar_params
+from orion_tpu.rewards import MathVerifierReward, ModelReward, extract_last_number
+from orion_tpu.rollout.engine import GenerationResult
+
+
+def test_extract_last_number():
+    assert extract_last_number("the answer is #### 42") == 42
+    assert extract_last_number("x = \\boxed{3/4} done") == 0.75
+    assert extract_last_number("costs $1,234.50 total") == 1234.5
+    assert extract_last_number("first 5 then 9.") == 9
+    assert extract_last_number("no numbers here") is None
+    assert extract_last_number("#### -3") == -3
+
+
+def _fake_result(completions, lens):
+    completions = jnp.asarray(completions)
+    B, T = completions.shape
+    mask = (jnp.arange(T)[None, :] < jnp.asarray(lens)[:, None]).astype(
+        jnp.float32)
+    return GenerationResult(
+        sequences=completions, completions=completions,
+        completion_mask=mask, completion_lens=jnp.asarray(lens),
+        logprobs=jnp.zeros((B, T)), prompt_lens=jnp.zeros(B, jnp.int32),
+        total_lens=jnp.asarray(lens))
+
+
+def test_math_verifier():
+    # fake "tokenizer": token id == ascii code
+    decode = lambda seqs: ["".join(chr(t) for t in s) for s in seqs]
+    rw = MathVerifierReward(decode)
+    toks = [[ord(c) for c in "= 12"] + [0] * 4,
+            [ord(c) for c in "= 13"] + [0] * 4]
+    res = _fake_result(np.array(toks), [4, 4])
+    scores = rw(res, {"answer": ["12", "12"]})
+    np.testing.assert_array_equal(scores, [1.0, 0.0])
+
+
+def test_model_reward_runs():
+    cfg = ModelConfig.tiny(dtype="float32")
+    rm = ScalarHeadModel(cfg)
+    params = init_scalar_params(rm, jax.random.key(0))
+    reward = ModelReward(rm, params)
+    comps = np.random.RandomState(0).randint(1, cfg.vocab_size, (3, 6))
+    res = _fake_result(comps, [6, 4, 2])
+    scores = np.asarray(reward(res, {}))
+    assert scores.shape == (3,) and np.isfinite(scores).all()
+    # score must read the value at the last *real* token: shortening a
+    # sequence changes which position is read
+    res2 = _fake_result(comps, [6, 4, 1])
+    scores2 = np.asarray(reward(res2, {}))
+    assert scores[2] != scores2[2]

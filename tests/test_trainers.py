@@ -1,0 +1,121 @@
+"""Integration tests (SURVEY.md §4): tiny model, full training loops for
+a few iterations, assert the rigged reward rises.
+
+The rigged reward pays for emitting token 7 — a signal the policy
+gradient can climb within a handful of iterations on a 2-layer model.
+"""
+
+import itertools
+
+import jax
+import numpy as np
+import pytest
+
+from orion_tpu.config import (GRPOConfig, ModelConfig, OnlineDPOConfig,
+                              OptimizerConfig, PPOConfig, RLOOConfig,
+                              RolloutConfig)
+from orion_tpu.models import (ScalarHeadModel, Transformer,
+                              init_params, init_scalar_params)
+from orion_tpu.trainers import (GRPOTrainer, OnlineDPOTrainer, PPOTrainer,
+                                RLOOTrainer)
+
+VOCAB = 32
+LUCKY = 7
+
+
+def tiny_model_cfg():
+    return ModelConfig.tiny(
+        vocab_size=VOCAB, hidden_size=32, intermediate_size=64,
+        num_layers=2, num_heads=2, num_kv_heads=2, dtype="float32")
+
+
+def lucky_token_reward(result, meta):
+    comp = np.asarray(result.completions)
+    mask = np.asarray(result.completion_mask)
+    return ((comp == LUCKY) * mask).sum(1) / np.maximum(mask.sum(1), 1)
+
+
+def prompt_stream(n_prompts, plen, seed=0, extra=None):
+    rng = np.random.RandomState(seed)
+    while True:
+        batch = {
+            "prompt_ids": rng.randint(1, VOCAB, (n_prompts, plen)),
+            "prompt_lens": np.full(n_prompts, plen, np.int64),
+        }
+        if extra:
+            batch.update(extra(n_prompts))
+        yield batch
+
+
+def _mk(cfg_cls, **kw):
+    kw.setdefault("model", tiny_model_cfg())
+    kw.setdefault("optimizer", OptimizerConfig(learning_rate=5e-3,
+                                               grad_clip=1.0))
+    kw.setdefault("rollout", RolloutConfig(max_new_tokens=8, temperature=1.0))
+    kw.setdefault("rollout_batch_size", 8)
+    kw.setdefault("minibatch_size", 8)
+    kw.setdefault("log_every", 0)
+    return cfg_cls(**kw)
+
+
+def _policy():
+    cfg = tiny_model_cfg()
+    model = Transformer(cfg)
+    params = init_params(model, jax.random.key(0), cfg)
+    return model, params
+
+
+def test_grpo_reward_goes_up():
+    cfg = _mk(GRPOConfig, group_size=4, kl_coef=0.0, num_epochs=1)
+    model, params = _policy()
+    tr = GRPOTrainer(cfg, model, params, reward_fn=lucky_token_reward)
+    hist = tr.train(prompt_stream(4, 5), num_iterations=8)
+    first, last = hist[0]["reward_mean"], hist[-1]["reward_mean"]
+    assert last > first + 0.05, (first, last)
+
+
+def test_ppo_reward_goes_up():
+    cfg = _mk(PPOConfig, kl_coef=0.0, num_epochs=2, vf_coef=0.05,
+              rollout_batch_size=16, minibatch_size=16,
+              optimizer=OptimizerConfig(learning_rate=1e-2, grad_clip=1.0))
+    model, params = _policy()
+    critic_model = ScalarHeadModel(tiny_model_cfg())
+    critic_params = init_scalar_params(critic_model, jax.random.key(1))
+    tr = PPOTrainer(cfg, model, params, critic_model, critic_params,
+                    reward_fn=lucky_token_reward)
+    hist = tr.train(prompt_stream(16, 5), num_iterations=12)
+    first = np.mean([h["reward_mean"] for h in hist[:3]])
+    last = np.mean([h["reward_mean"] for h in hist[-3:]])
+    assert last > first + 0.05, (first, last)
+    assert all(np.isfinite(h["loss"]) for h in hist)
+
+
+def test_rloo_reward_goes_up():
+    cfg = _mk(RLOOConfig, group_size=4, kl_coef=0.0, num_epochs=1)
+    model, params = _policy()
+    tr = RLOOTrainer(cfg, model, params, reward_fn=lucky_token_reward)
+    hist = tr.train(prompt_stream(4, 5), num_iterations=8)
+    first, last = hist[0]["reward_mean"], hist[-1]["reward_mean"]
+    assert last > first + 0.05, (first, last)
+
+
+def test_online_dpo_margin_learning():
+    cfg = _mk(OnlineDPOConfig, group_size=2, beta=0.5, num_epochs=1)
+    model, params = _policy()
+    tr = OnlineDPOTrainer(cfg, model, params, reward_fn=lucky_token_reward)
+    hist = tr.train(prompt_stream(8, 5), num_iterations=6)
+    first, last = hist[0]["reward_mean"], hist[-1]["reward_mean"]
+    assert last > first, (first, last)
+    assert all(np.isfinite(h["dpo_loss"]) for h in hist)
+
+
+def test_ppo_kl_penalty_restrains_drift():
+    """With a huge kl_coef the policy should stay near the ref."""
+    cfg = _mk(PPOConfig, kl_coef=10.0, num_epochs=1)
+    model, params = _policy()
+    critic_model = ScalarHeadModel(tiny_model_cfg())
+    critic_params = init_scalar_params(critic_model, jax.random.key(1))
+    tr = PPOTrainer(cfg, model, params, critic_model, critic_params,
+                    reward_fn=lucky_token_reward)
+    hist = tr.train(prompt_stream(8, 5), num_iterations=4)
+    assert abs(hist[-1]["kl"]) < 1.0
