@@ -1,0 +1,123 @@
+"""Benchmark: GRPO samples/sec (rollout + update) on one TPU chip.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "samples/sec", "vs_baseline": N}
+
+The BASELINE metric (BASELINE.json) is "PPO samples/sec (rollout+update)";
+no published reference number is recoverable (BASELINE.json.published == {},
+empty reference mount — see BASELINE.md), so ``vs_baseline`` is reported
+against the first value this bench ever recorded (BENCH_SELF.json),
+i.e. round-over-round self-improvement, 1.0 on the first run.
+
+Presets (env ORION_BENCH_PRESET): "small" (~320M llama, default on TPU),
+"tiny" (CPU/smoke).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import numpy as np
+
+
+def _preset():
+    import jax
+
+    name = os.environ.get("ORION_BENCH_PRESET")
+    if name is None:
+        name = "small" if jax.default_backend() == "tpu" else "tiny"
+    from orion_tpu.config import GRPOConfig, ModelConfig
+
+    cfg = GRPOConfig()
+    if name == "small":
+        # ~320M llama-arch model: real MXU/HBM load, <16G HBM with
+        # policy + ref + Adam state resident.
+        cfg.model = ModelConfig(
+            arch="llama", vocab_size=32000, hidden_size=1024,
+            intermediate_size=4096, num_layers=16, num_heads=16,
+            num_kv_heads=8, max_seq_len=1024)
+        cfg.rollout.max_prompt_len = 128
+        cfg.rollout.max_new_tokens = 128
+        cfg.rollout_batch_size = 8
+        cfg.group_size = 4
+        cfg.minibatch_size = 8
+    else:
+        cfg.model = ModelConfig.tiny()
+        cfg.rollout.max_prompt_len = 16
+        cfg.rollout.max_new_tokens = 16
+        cfg.rollout_batch_size = 4
+        cfg.group_size = 2
+        cfg.minibatch_size = 4
+    cfg.num_epochs = 1
+    cfg.rollout.temperature = 1.0
+    return name, cfg
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from orion_tpu.models.transformer import Transformer, init_params
+    from orion_tpu.trainers.grpo import GRPOTrainer
+
+    name, cfg = _preset()
+    model = Transformer(cfg.model)
+    params = init_params(model, jax.random.key(0), cfg.model)
+
+    def reward_fn(result, batch):
+        # Rule-style host reward: rewards longer distinct completions.
+        toks = np.asarray(result.completions)
+        return np.asarray(
+            [len(np.unique(t)) for t in toks], np.float32) / toks.shape[1]
+
+    trainer = GRPOTrainer(cfg, model, params, reward_fn=reward_fn,
+                          eos_token_id=1, pad_token_id=0)
+
+    rs = np.random.RandomState(0)
+    B, P = cfg.rollout_batch_size, cfg.rollout.max_prompt_len
+
+    def batch():
+        return {
+            "prompt_ids": rs.randint(
+                2, cfg.model.vocab_size, (B, P)).astype(np.int32),
+            "prompt_lens": np.full((B,), P, np.int32),
+        }
+
+    n_samples = B * cfg.group_size
+    # Warmup iteration triggers all compiles (prefill, decode loop,
+    # logprob recompute, update); measured iterations reuse the cache.
+    trainer.train(iter([batch()]), num_iterations=1)
+
+    iters = int(os.environ.get("ORION_BENCH_ITERS", "3"))
+    t0 = time.perf_counter()
+    trainer.train(iter([batch() for _ in range(iters)]),
+                  num_iterations=iters)
+    jax.block_until_ready(trainer.state.params)
+    dt = time.perf_counter() - t0
+    value = n_samples * iters / dt
+
+    self_path = os.path.join(os.path.dirname(__file__), "BENCH_SELF.json")
+    key = f"grpo_samples_per_sec_{name}"
+    base = {}
+    if os.path.exists(self_path):
+        with open(self_path) as f:
+            base = json.load(f)
+    if key not in base:
+        base[key] = value
+        with open(self_path, "w") as f:
+            json.dump(base, f, indent=1)
+    vs = value / base[key] if base[key] else 1.0
+
+    print(json.dumps({
+        "metric": f"GRPO samples/sec (rollout+update), preset={name}, "
+                  f"{jax.default_backend()}",
+        "value": round(value, 4),
+        "unit": "samples/sec",
+        "vs_baseline": round(vs, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

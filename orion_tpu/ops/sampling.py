@@ -40,21 +40,28 @@ def sample_tokens(rng: jax.Array, logits: jnp.ndarray, temperature: float,
                   top_k: int = 0, top_p: float = 1.0) -> tuple:
     """Sample next tokens from [B, V] logits.
 
-    Returns (tokens [B] int32, logprobs [B] f32).  temperature == 0.0
-    means greedy (logprob computed from the untempered distribution).
+    Returns (tokens [B] int32, sample_logprobs [B] f32,
+    policy_logprobs [B] f32).  ``sample_logprobs`` is the logprob under
+    the *actual* sampling distribution (post temperature + truncation);
+    ``policy_logprobs`` is under the raw untempered policy — the
+    behavior-policy logprob the async off-policy importance ratio needs
+    (SURVEY.md §3b).  temperature == 0.0 means greedy.
     """
     logits = logits.astype(jnp.float32)
+    raw_logps = jax.nn.log_softmax(logits, axis=-1)
+
+    def take(logps, tokens):
+        return jnp.take_along_axis(logps, tokens[:, None], axis=-1)[:, 0]
+
     if temperature == 0.0:
-        tokens = jnp.argmax(logits, axis=-1)
-        logps = jax.nn.log_softmax(logits, axis=-1)
-        return tokens.astype(jnp.int32), jnp.take_along_axis(
-            logps, tokens[:, None], axis=-1)[:, 0]
+        tokens = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        lp = take(raw_logps, tokens)
+        return tokens, lp, lp
     logits = logits / temperature
     if top_k > 0:
         logits = _mask_top_k(logits, top_k)
     if top_p < 1.0:
         logits = _mask_top_p(logits, top_p)
     logps = jax.nn.log_softmax(logits, axis=-1)
-    tokens = jax.random.categorical(rng, logits, axis=-1)
-    return tokens.astype(jnp.int32), jnp.take_along_axis(
-        logps, tokens[:, None], axis=-1)[:, 0]
+    tokens = jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
+    return tokens, take(logps, tokens), take(raw_logps, tokens)

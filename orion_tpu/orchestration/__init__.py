@@ -1,0 +1,4 @@
+from orion_tpu.orchestration.async_orchestrator import (  # noqa: F401
+    AsyncOrchestrator,
+    split_devices,
+)

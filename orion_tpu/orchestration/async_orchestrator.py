@@ -1,0 +1,233 @@
+"""Async RLHF orchestration: decoupled rollout + learner workers
+(SURVEY.md §2 #10-11, §3b — SPEC config 4, the reference's signature
+capability).
+
+TPU-native design: the reference decouples vLLM generation processes
+from trainer processes and bridges them with an NCCL broadcast group.
+Here both groups are *device subsets of one slice* driven from one host
+process:
+
+- the **learner** owns the train mesh (FSDP/TP layout) and runs the
+  jitted update step;
+- the **rollout worker** is a host thread that owns the rollout mesh
+  (inference layout) and drives the generate loop;
+- the **experience channel** is a bounded host-side queue whose
+  ``maxsize`` bounds off-policy staleness (maxsize=1 ⇒ classic one-step
+  async RLHF);
+- the **weight-sync channel** is ``jax.device_put`` of the policy params
+  from the train-mesh sharding to the rollout-mesh sharding — XLA lowers
+  the reshard to ICI transfers; there is no user-space comm code.
+
+Off-policy correctness: trainers consume the engine's raw behavior
+logprobs as ``old_logprobs`` (``cfg.async_mode=True`` — see
+``BaseTrainer.behavior_logprobs``) so PPO-family clipped ratios carry
+the staleness correction.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import queue
+import threading
+import time
+from typing import Any, Iterator, Optional, Sequence
+
+import jax
+import numpy as np
+
+from orion_tpu.models.sharded import mesh_shardings_for
+from orion_tpu.parallel.mesh import make_mesh
+from orion_tpu.config import MeshConfig
+from orion_tpu.trainers.base import BaseTrainer
+
+
+def split_devices(devices: Sequence, n_rollout: int) -> tuple:
+    """(rollout_devices, train_devices).  Rollout gets the *first* n
+    devices (on a real slice: one contiguous ICI neighborhood), the
+    learner the rest."""
+    if not 0 < n_rollout < len(devices):
+        raise ValueError(
+            f"need 0 < rollout devices < {len(devices)}, got {n_rollout}")
+    return tuple(devices[:n_rollout]), tuple(devices[n_rollout:])
+
+
+@dataclasses.dataclass
+class _Item:
+    result_host: dict        # GenerationResult fields as numpy
+    scores: np.ndarray       # [B]
+    version: int             # weight version used for generation
+
+
+class AsyncOrchestrator:
+    """Runs a trainer in decoupled rollout/learner mode.
+
+    Args:
+      trainer: any BaseTrainer subclass, already constructed with params
+        living on the *train* device group and ``cfg.async_mode=True``.
+      rollout_devices: device subset for the generation group.
+      rollout_mesh_cfg: mesh layout for the rollout group (default: pure
+        FSDP over the group — generation is memory-bound, params sharded).
+      staleness: bound on (learner version − behavior version); maps to
+        the experience-queue capacity.
+    """
+
+    def __init__(self, trainer: BaseTrainer, rollout_devices: Sequence,
+                 rollout_mesh_cfg: Optional[MeshConfig] = None,
+                 staleness: Optional[int] = None):
+        if not trainer.cfg.async_mode:
+            raise ValueError(
+                "trainer.cfg.async_mode must be True: async trainers "
+                "must use behavior logprobs for the importance ratio")
+        self.trainer = trainer
+        if staleness is None:
+            staleness = trainer.cfg.async_staleness
+        if staleness < 1:
+            raise ValueError("async_staleness must be >= 1")
+        self.staleness = staleness
+
+        mesh_cfg = rollout_mesh_cfg or MeshConfig(data=1, fsdp=-1, seq=1,
+                                                  tensor=1)
+        self.rollout_mesh = make_mesh(mesh_cfg, devices=rollout_devices)
+        init_args = (np.zeros((1, 2), np.int32), np.zeros((1, 2), np.int32))
+        self._rollout_shardings = mesh_shardings_for(
+            trainer.model, self.rollout_mesh, init_args)
+
+        # A second engine instance bound to the rollout group; the
+        # trainer's own (sync) engine is left untouched.
+        from orion_tpu.rollout import RolloutEngine
+
+        self.engine = RolloutEngine(
+            trainer.model, trainer.cfg.model, trainer.cfg.rollout,
+            eos_token_id=trainer.engine.eos_token_id,
+            pad_token_id=trainer.engine.pad_token_id)
+
+        self._queue: queue.Queue = queue.Queue(maxsize=staleness)
+        self._weights_lock = threading.Lock()
+        self._version_cv = threading.Condition()
+        self._stop = threading.Event()
+        self._rollout_error: Optional[BaseException] = None
+        self._version = 0
+        self._broadcast_weights()  # version 0: initial policy
+        self._rng = jax.random.key(trainer.cfg.seed + 7919)
+
+    # ------------------------------------------------------------------
+    # weight-sync channel (SURVEY.md §2 #11)
+    # ------------------------------------------------------------------
+    def _broadcast_weights(self) -> None:
+        """Train layout → rollout layout reshard over ICI.  The learner
+        calls this after every update; the rollout worker picks up the
+        freshest version at its next generate dispatch."""
+        snapshot = jax.device_put(self.trainer.state.params,
+                                  self._rollout_shardings)
+        with self._weights_lock:
+            self._rollout_params = snapshot
+
+    # ------------------------------------------------------------------
+    # rollout worker (host thread driving the rollout device group)
+    # ------------------------------------------------------------------
+    def _rollout_loop(self, prompt_iter: Iterator[dict],
+                      n_batches: int, base_version: int) -> None:
+        try:
+            for i in range(n_batches):
+                if self._stop.is_set():
+                    return
+                # Strict staleness gate: batch i of this run is trained
+                # at learner version base+i, so generating it with
+                # weights older than base+i - staleness would breach the
+                # bound.  The queue's maxsize alone can't guarantee this
+                # — the batch *being generated* is in flight beyond the
+                # queue.
+                needed = base_version + i - self.staleness
+                with self._version_cv:
+                    while self._version < needed and not self._stop.is_set():
+                        self._version_cv.wait(timeout=0.1)
+                if self._stop.is_set():
+                    return
+                batch = next(prompt_iter)
+                ids, lens, meta = self.trainer.prepare_prompts(batch)
+                with self._weights_lock:
+                    params = self._rollout_params
+                    version = self._version
+                self._rng, sub = jax.random.split(self._rng)
+                result = self.engine.generate(
+                    np.asarray(ids), np.asarray(lens), sub, params=params)
+                scores = np.asarray(self.trainer.score(result, meta))
+                # Host staging: the experience crosses the group boundary
+                # as numpy; the learner's jitted programs re-place it on
+                # the train mesh.
+                result_host = {
+                    f.name: np.asarray(getattr(result, f.name))
+                    for f in dataclasses.fields(result)}
+                item = _Item(result_host, scores, version)
+                while not self._stop.is_set():
+                    try:
+                        self._queue.put(item, timeout=0.1)
+                        break
+                    except queue.Full:
+                        continue
+        except BaseException as e:  # surfaced to the learner
+            self._rollout_error = e
+            self._stop.set()
+
+    # ------------------------------------------------------------------
+    def train(self, prompt_iter: Iterator[dict],
+              num_iterations: Optional[int] = None) -> list:
+        """The decoupled loop (SURVEY.md §3b).  Returns metrics history."""
+        from orion_tpu.rollout import GenerationResult
+
+        trainer = self.trainer
+        n = num_iterations or trainer.cfg.total_iterations
+        # Reset for reuse: a prior train() call leaves _stop set and may
+        # leave an undrained item behind.
+        self._stop.clear()
+        self._rollout_error = None
+        while True:
+            try:
+                self._queue.get_nowait()
+            except queue.Empty:
+                break
+        worker = threading.Thread(
+            target=self._rollout_loop, args=(prompt_iter, n, self._version),
+            name="rollout-worker", daemon=True)
+        worker.start()
+        try:
+            for it in range(n):
+                t0 = time.perf_counter()
+                item = None
+                while item is None:
+                    if self._rollout_error is not None:
+                        raise RuntimeError(
+                            "rollout worker died") from self._rollout_error
+                    try:
+                        item = self._queue.get(timeout=0.1)
+                    except queue.Empty:
+                        continue
+                t_wait = time.perf_counter() - t0
+                result = GenerationResult(**item.result_host)
+                experience, exp_stats = trainer.build_experience(
+                    result, item.scores)
+                t1 = time.perf_counter()
+                stats = trainer.update_epochs(experience)
+                self._broadcast_weights()
+                with self._version_cv:
+                    self._version += 1
+                    self._version_cv.notify_all()
+                t2 = time.perf_counter()
+                stats.update(exp_stats)
+                n_samples = int(item.result_host["prompt_lens"].shape[0])
+                stats.update({
+                    "iteration": it,
+                    "staleness": self._version - 1 - item.version,
+                    "time_learner_wait_s": t_wait,
+                    "time_update_s": t2 - t1,
+                    "samples_per_sec": n_samples / (t2 - t0),
+                })
+                trainer.metrics_history.append(stats)
+                if trainer.cfg.log_every and it % trainer.cfg.log_every == 0:
+                    trainer.log(stats)
+        finally:
+            self._stop.set()
+            worker.join(timeout=30.0)
+        if self._rollout_error is not None:
+            raise RuntimeError("rollout worker died") from self._rollout_error
+        return trainer.metrics_history

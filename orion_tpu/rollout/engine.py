@@ -42,7 +42,8 @@ class GenerationResult:
     completions: jnp.ndarray      # [B, T] completion tokens (pad after EOS)
     completion_mask: jnp.ndarray  # [B, T] 1.0 for real completion tokens
     completion_lens: jnp.ndarray  # [B] number of real completion tokens
-    logprobs: jnp.ndarray         # [B, T] f32 behavioral-policy logprobs
+    logprobs: jnp.ndarray         # [B, T] f32 sampling-distribution logprobs
+    policy_logprobs: jnp.ndarray  # [B, T] f32 raw (untempered) policy logprobs
     prompt_lens: jnp.ndarray      # [B]
     total_lens: jnp.ndarray       # [B] prompt + completion lengths
 
@@ -102,38 +103,41 @@ class RolloutEngine:
         last = jnp.take_along_axis(
             logits, (prompt_lens - 1)[:, None, None], axis=1)[:, 0]
         rng, sub = jax.random.split(rng)
-        tok0, lp0 = sample(sub, last)
+        tok0, lp0, plp0 = sample(sub, last)
 
         tokens = jnp.full((B, T), pad, jnp.int32).at[:, 0].set(tok0)
         logps = jnp.zeros((B, T), jnp.float32).at[:, 0].set(lp0)
+        plogps = jnp.zeros((B, T), jnp.float32).at[:, 0].set(plp0)
         done = jnp.zeros((B,), bool) if eos is None else (tok0 == eos)
         comp_len = jnp.ones((B,), jnp.int32)
 
         def cond(c):
-            t, _, _, _, done, _, _, _ = c
+            t, _, _, _, done, _, _, _, _ = c
             return (t < T) & ~jnp.all(done)
 
         def body(c):
-            t, cur_tok, cur_pos, rng, done, tokens, logps, state = c
+            t, cur_tok, cur_pos, rng, done, tokens, logps, plogps, state = c
             cache, comp_len = state
             step_logits, cache = self.model.apply(
                 {"params": params}, cur_tok[:, None], cur_pos[:, None],
                 cache)
             rng, sub = jax.random.split(rng)
-            nxt, lp = sample(sub, step_logits[:, 0])
+            nxt, lp, plp = sample(sub, step_logits[:, 0])
             nxt = jnp.where(done, pad, nxt)
             lp = jnp.where(done, 0.0, lp)
+            plp = jnp.where(done, 0.0, plp)
             tokens = tokens.at[:, t].set(nxt, mode="drop")
             logps = logps.at[:, t].set(lp, mode="drop")
+            plogps = plogps.at[:, t].set(plp, mode="drop")
             comp_len = comp_len + (~done).astype(jnp.int32)
             if eos is not None:
                 done = done | (nxt == eos)
             return (t + 1, nxt, cur_pos + 1, rng, done, tokens, logps,
-                    (cache, comp_len))
+                    plogps, (cache, comp_len))
 
         init = (jnp.int32(1), tok0, prompt_lens, rng, done, tokens, logps,
-                (cache, comp_len))
-        _, _, _, _, done, tokens, logps, (cache, comp_len) = \
+                plogps, (cache, comp_len))
+        _, _, _, _, done, tokens, logps, plogps, (cache, comp_len) = \
             jax.lax.while_loop(cond, body, init)
 
         mask = (jnp.arange(T)[None, :] < comp_len[:, None]).astype(jnp.float32)
@@ -144,6 +148,7 @@ class RolloutEngine:
             completion_mask=mask,
             completion_lens=comp_len,
             logprobs=logps,
+            policy_logprobs=plogps,
             prompt_lens=prompt_lens,
             total_lens=prompt_lens + comp_len,
         )

@@ -4,9 +4,12 @@ pipeline skeleton, and the sync weight-sync channel.
 Control flow contract (SURVEY.md §3a): each iteration is
   prompts → rollout.generate → score → advantages → minibatch updates
   → weight-sync → metrics.
-Algorithm subclasses implement ``make_experience`` (pipeline front half)
-and ``loss_fn`` (pure jittable loss over a minibatch); the base class
-owns generation, minibatching, the jitted update step, and logging.
+Algorithm subclasses implement ``build_experience`` (experience from a
+finished generation — it must not generate, so the async orchestrator
+can call it on the learner side) and ``loss_fn`` (pure jittable loss
+over a minibatch); the base class owns prompt prep, generation,
+minibatching, the jitted update step, and logging.  Do NOT override
+``make_experience`` — it is the sync-mode composition of those hooks.
 """
 
 from __future__ import annotations
@@ -157,8 +160,52 @@ class BaseTrainer:
         scores = self.reward_fn(result, batch)
         return jnp.asarray(np.asarray(scores), jnp.float32)
 
-    def make_experience(self, batch: dict) -> Dict[str, jnp.ndarray]:
+    def prepare_prompts(self, batch: dict):
+        """(prompt_ids, prompt_lens, meta) — group trainers (GRPO/RLOO/
+        Online-DPO) repeat each prompt ``cfg.group_size`` times; PPO has
+        no group axis.  Runs host-side (rollout worker in async mode)."""
+        k = getattr(self.cfg, "group_size", 1)
+        ids = np.asarray(batch["prompt_ids"])
+        lens = np.asarray(batch["prompt_lens"])
+        meta = {key: np.asarray(v) for key, v in batch.items()
+                if key not in ("prompt_ids", "prompt_lens")}
+        if k > 1:
+            ids = np.repeat(ids, k, axis=0)
+            lens = np.repeat(lens, k, axis=0)
+            meta = {key: np.repeat(v, k, axis=0) for key, v in meta.items()}
+        return ids, lens, meta
+
+    def behavior_logprobs(self, result: GenerationResult) -> jnp.ndarray:
+        """old_logprobs for the importance ratio.
+
+        Sync mode: recomputed under the *current* training graph, so the
+        clipped ratio is exactly 1 on the first epoch (no sampler/
+        trainer drift in the objective).  Async mode: the engine's raw
+        policy logprobs — the *stale* behavior policy that actually
+        produced the tokens — so the ratio carries the one-step
+        off-policy correction (SURVEY.md §3b).
+        """
+        if self.cfg.async_mode:
+            return result.policy_logprobs
+        T = result.completions.shape[1]
+        lp, _ = self._jit_logprobs(
+            self.state.params, result.sequences, result.prompt_lens,
+            max_new=T)
+        return lp
+
+    def build_experience(self, result: GenerationResult, scores):
+        """(experience dict, stats dict) from a finished generation.
+        Algorithm-specific; must not generate (async mode calls it on the
+        learner with a result produced by the rollout worker)."""
         raise NotImplementedError
+
+    def make_experience(self, batch: dict):
+        """Synchronous pipeline front half: prompts → generate → score →
+        experience (SURVEY.md §3a)."""
+        ids, lens, meta = self.prepare_prompts(batch)
+        result = self.generate(ids, lens)
+        scores = self.score(result, meta)
+        return self.build_experience(result, scores)
 
     def _apply_update(self, experience, idx) -> dict:
         """One minibatch step.  Subclasses with extra train states (PPO's

@@ -23,24 +23,13 @@ from orion_tpu.trainers.base import BaseTrainer
 class GRPOTrainer(BaseTrainer):
     cfg: GRPOConfig
 
-    def make_experience(self, batch: dict):
+    def build_experience(self, result, scores):
         k = self.cfg.group_size
-        prompt_ids = np.repeat(np.asarray(batch["prompt_ids"]), k, axis=0)
-        prompt_lens = np.repeat(np.asarray(batch["prompt_lens"]), k, axis=0)
-        meta = {key: np.repeat(np.asarray(v), k, axis=0)
-                for key, v in batch.items()
-                if key not in ("prompt_ids", "prompt_lens")}
-
-        result = self.generate(prompt_ids, prompt_lens)
-        scores = self.score(result, meta)
-
         T = result.completions.shape[1]
-        # Old logprobs are recomputed under the *training* graph (not the
-        # engine's sampling distribution, which bakes in temperature /
-        # top-k/p) so the clipped ratio is exactly 1 on the first epoch.
-        old_lp, _ = self._jit_logprobs(
-            self.state.params, result.sequences, result.prompt_lens,
-            max_new=T)
+        # Sync: old logprobs recomputed under the *training* graph so the
+        # clipped ratio is exactly 1 on the first epoch; async: the stale
+        # behavior policy's logprobs (see BaseTrainer.behavior_logprobs).
+        old_lp = self.behavior_logprobs(result)
         ref_lp, _ = self._jit_logprobs(
             self.ref_params, result.sequences, result.prompt_lens, max_new=T)
 

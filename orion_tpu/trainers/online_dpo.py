@@ -18,17 +18,9 @@ from orion_tpu.trainers.base import BaseTrainer
 class OnlineDPOTrainer(BaseTrainer):
     cfg: OnlineDPOConfig
 
-    def make_experience(self, batch: dict):
+    def build_experience(self, result, scores):
         assert self.cfg.group_size == 2, "online DPO samples pairs"
-        prompt_ids = np.repeat(np.asarray(batch["prompt_ids"]), 2, axis=0)
-        prompt_lens = np.repeat(np.asarray(batch["prompt_lens"]), 2, axis=0)
-        meta = {key: np.repeat(np.asarray(v), 2, axis=0)
-                for key, v in batch.items()
-                if key not in ("prompt_ids", "prompt_lens")}
-
-        result = self.generate(prompt_ids, prompt_lens)
-        scores = np.asarray(self.score(result, meta))  # [2N]
-
+        scores = np.asarray(scores)  # [2N]
         T = result.completions.shape[1]
         ref_lp, _ = self._jit_logprobs(
             self.ref_params, result.sequences, result.prompt_lens, max_new=T)

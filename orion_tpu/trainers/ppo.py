@@ -61,17 +61,10 @@ class PPOTrainer(BaseTrainer):
         return jnp.take_along_axis(values, idx, axis=1) * mask
 
     # ------------------------------------------------------------------
-    def make_experience(self, batch: dict):
-        result = self.generate(batch["prompt_ids"], batch["prompt_lens"])
-        meta = {k: v for k, v in batch.items()
-                if k not in ("prompt_ids", "prompt_lens")}
-        scores = self.score(result, meta)
-
+    def build_experience(self, result, scores):
         T = result.completions.shape[1]
         mask = result.completion_mask
-        old_lp, _ = self._jit_logprobs(
-            self.state.params, result.sequences, result.prompt_lens,
-            max_new=T)
+        old_lp = self.behavior_logprobs(result)
         ref_lp, _ = self._jit_logprobs(
             self.ref_params, result.sequences, result.prompt_lens, max_new=T)
         values = self._jit_values(

@@ -21,22 +21,11 @@ from orion_tpu.trainers.base import BaseTrainer
 class RLOOTrainer(BaseTrainer):
     cfg: RLOOConfig
 
-    def make_experience(self, batch: dict):
+    def build_experience(self, result, scores):
         k = self.cfg.group_size
-        prompt_ids = np.repeat(np.asarray(batch["prompt_ids"]), k, axis=0)
-        prompt_lens = np.repeat(np.asarray(batch["prompt_lens"]), k, axis=0)
-        meta = {key: np.repeat(np.asarray(v), k, axis=0)
-                for key, v in batch.items()
-                if key not in ("prompt_ids", "prompt_lens")}
-
-        result = self.generate(prompt_ids, prompt_lens)
-        scores = self.score(result, meta)
-
         T = result.completions.shape[1]
         mask = result.completion_mask
-        old_lp, _ = self._jit_logprobs(
-            self.state.params, result.sequences, result.prompt_lens,
-            max_new=T)
+        old_lp = self.behavior_logprobs(result)
         ref_lp, _ = self._jit_logprobs(
             self.ref_params, result.sequences, result.prompt_lens, max_new=T)
 

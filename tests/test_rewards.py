@@ -25,7 +25,8 @@ def _fake_result(completions, lens):
     return GenerationResult(
         sequences=completions, completions=completions,
         completion_mask=mask, completion_lens=jnp.asarray(lens),
-        logprobs=jnp.zeros((B, T)), prompt_lens=jnp.zeros(B, jnp.int32),
+        logprobs=jnp.zeros((B, T)), policy_logprobs=jnp.zeros((B, T)),
+        prompt_lens=jnp.zeros(B, jnp.int32),
         total_lens=jnp.asarray(lens))
 
 
