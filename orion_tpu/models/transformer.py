@@ -30,6 +30,7 @@ import jax.numpy as jnp
 
 from orion_tpu.config import ModelConfig
 from orion_tpu.ops.attention import attention
+from orion_tpu.ops.paged_kv import is_paged, write_paged_tokens
 from orion_tpu.ops.rotary import apply_rotary
 
 KVCache = List[dict]  # per-layer {"k": [B,L,Hkv,D], "v": [B,L,Hkv,D]}
@@ -97,8 +98,28 @@ class Attention(nn.Module):
         rotary_dim = int(D * cfg.rotary_pct)
         q, k = apply_rotary(q, k, positions, rotary_dim, cfg.rope_theta)
 
-        new_cache = None
-        if layer_cache is not None:
+        scale = 1.0 / D ** 0.5
+        paged_decode_out = None
+        if is_paged(layer_cache):
+            # Paged-KV path (rollout engine with RolloutConfig.paged).
+            new_cache = write_paged_tokens(layer_cache, k, v, positions)
+            if L == 1:
+                # Decode step: Pallas paged attention over the pool.
+                from orion_tpu.ops.pallas.paged_attention import (
+                    paged_decode_attention)
+                paged_decode_out = paged_decode_attention(
+                    q[:, 0], new_cache["k_pages"], new_cache["v_pages"],
+                    new_cache["block_tables"], positions[:, 0] + 1, scale)
+                keys = values = None
+            else:
+                # Prefill (full or chunked): gather the sequence's pages
+                # into slot order so slot j holds absolute position j —
+                # then the shared mask formula below covers history and
+                # in-chunk keys alike.  (Gather cost ≈ the dense cache;
+                # paged wins on the decode side, same trade vLLM makes.)
+                from orion_tpu.ops.paged_kv import gather_paged_kv
+                keys, values = gather_paged_kv(new_cache)
+        elif layer_cache is not None:
             starts = positions[:, 0]
 
             def write(cache, new):
@@ -111,18 +132,21 @@ class Attention(nn.Module):
             new_cache = {"k": ck, "v": cv}
             keys, values = ck, cv
         else:
+            new_cache = None
             keys, values = k, v
 
-        # Mask: query at absolute position p attends to cache slots
-        # j <= p.  Slots map 1:1 to absolute positions in both the
-        # prefill and decode paths (decode overwrites the right-padded
-        # prompt tail slot by slot), so one formula covers train,
-        # prefill and decode.
-        key_slots = jnp.arange(keys.shape[1], dtype=positions.dtype)
-        mask = key_slots[None, None, :] <= positions[:, :, None]
-
-        out = attention(q, keys, values, mask, scale=1.0 / D ** 0.5,
-                        impl=cfg.attention_impl)
+        if paged_decode_out is not None:
+            out = paged_decode_out[:, None, :, :]
+        else:
+            # Mask: query at absolute position p attends to cache slots
+            # j <= p.  Slots map 1:1 to absolute positions in the train,
+            # prefill, decode and paged-gather paths (decode overwrites
+            # the right-padded prompt tail slot by slot), so one formula
+            # covers all of them.
+            key_slots = jnp.arange(keys.shape[1], dtype=positions.dtype)
+            mask = key_slots[None, None, :] <= positions[:, :, None]
+            out = attention(q, keys, values, mask, scale=scale,
+                            impl=cfg.attention_impl, q_positions=positions)
         out = out.reshape(B, L, H * D)
         out = _dense(cfg.hidden_size, ("heads", "embed"),
                      cfg.attn_bias, cfg, "o_proj")(out)

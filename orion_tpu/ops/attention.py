@@ -43,17 +43,27 @@ def reference_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
 def attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
               mask: jnp.ndarray, scale: float,
               impl: str = "reference",
-              segment_positions: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+              q_positions: Optional[jnp.ndarray] = None) -> jnp.ndarray:
     """Dispatch on attention implementation.
 
-    impl: "auto"|"reference" -> jnp einsum; "flash" -> Pallas flash
-    attention (training shapes); decode paths call the paged kernel
-    directly from the rollout engine.
+    impl: "auto"|"reference" -> jnp einsum over ``mask``; "flash" ->
+    Pallas flash attention over the positional rule
+    ``kv_slot <= q_position`` (needs ``q_positions`` [B, Lq]).
+
+    CONTRACT: the flash path does NOT read ``mask`` — callers selecting
+    impl="flash" must guarantee mask ≡ (kv_slot <= q_position), which
+    holds for every mask built in models/transformer.py.  A mask with
+    extra structure (padding-aware, bidirectional, packed-segment)
+    requires impl="reference".  Decode steps (Lq == 1) always take the
+    reference path — a 1-row MXU tile would waste the systolic array;
+    the paged decode kernel covers that case from the rollout engine.
     """
     n_rep = q.shape[2] // k.shape[2]
-    if impl == "flash":
+    if impl == "flash" and q.shape[1] > 1:
+        if q_positions is None:
+            raise ValueError("flash attention requires q_positions")
         from orion_tpu.ops.pallas.flash_attention import flash_attention_gqa
-        return flash_attention_gqa(q, k, v, mask, scale)
+        return flash_attention_gqa(q, k, v, q_positions, scale)
     k = repeat_kv(k, n_rep)
     v = repeat_kv(v, n_rep)
     return reference_attention(q, k, v, mask, scale)

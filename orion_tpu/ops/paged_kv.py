@@ -1,0 +1,79 @@
+"""Paged KV cache: page pool + block tables (SURVEY.md §2 #5).
+
+TPU-native equivalent of vLLM's paged KV memory: each layer owns a pool
+of fixed-size pages [num_pages, Hkv, page_size, D]; a block table maps
+(sequence, page-slot) → pool page.  All structures are fixed-capacity
+(XLA static shapes); *which* page a table entry points at is runtime
+data, which is what makes reuse/continuous batching possible without
+recompilation.
+
+The default allocator here is the trivial contiguous one (seq b gets
+pages [b*m, (b+1)*m)); the native runtime's block allocator
+(orion_tpu/runtime) hands out real dynamic tables for continuous
+batching while this module stays the device-side data plane.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax.numpy as jnp
+
+
+def init_paged_cache(num_layers: int, batch: int, max_len: int,
+                     num_kv_heads: int, head_dim: int, page_size: int,
+                     num_pages: int = 0, dtype=jnp.bfloat16) -> List[dict]:
+    """Per-layer {"k_pages", "v_pages", "block_tables"} with a contiguous
+    block-table assignment.  max_len is rounded up to whole pages."""
+    pages_per_seq = -(-max_len // page_size)
+    if num_pages <= 0:
+        num_pages = batch * pages_per_seq
+    if num_pages < batch * pages_per_seq:
+        raise ValueError(
+            f"pool of {num_pages} pages < {batch}x{pages_per_seq} needed")
+    bt = (jnp.arange(batch, dtype=jnp.int32)[:, None] * pages_per_seq
+          + jnp.arange(pages_per_seq, dtype=jnp.int32)[None, :])
+    shape = (num_pages, num_kv_heads, page_size, head_dim)
+    return [{"k_pages": jnp.zeros(shape, dtype),
+             "v_pages": jnp.zeros(shape, dtype),
+             "block_tables": bt}
+            for _ in range(num_layers)]
+
+
+def write_paged_tokens(layer_cache: dict, k_new: jnp.ndarray,
+                       v_new: jnp.ndarray,
+                       positions: jnp.ndarray) -> dict:
+    """Scatter new tokens into the pool.
+
+    k_new/v_new: [B, L, Hkv, D]; positions: [B, L] absolute positions.
+    Token (b, t) lands in page block_tables[b, pos//page_size] at slot
+    pos % page_size.  Returns the updated layer cache (functional).
+    """
+    bt = layer_cache["block_tables"]
+    page_size = layer_cache["k_pages"].shape[2]
+    pages = jnp.take_along_axis(bt, positions // page_size, axis=1)  # [B, L]
+    slots = positions % page_size                                     # [B, L]
+    # k_pages[pages, :, slots, :] selects [B, L, Hkv, D] — matching k_new.
+    k_pages = layer_cache["k_pages"].at[pages, :, slots, :].set(k_new)
+    v_pages = layer_cache["v_pages"].at[pages, :, slots, :].set(v_new)
+    return {"k_pages": k_pages, "v_pages": v_pages, "block_tables": bt}
+
+
+def gather_paged_kv(layer_cache: dict) -> tuple:
+    """Gather each sequence's pages into slot order: returns
+    (k, v) [B, max_pages*page_size, Hkv, D] where slot j holds the
+    token at absolute position j (zero pages where unwritten).  Used by
+    the prefill path; callers mask by position."""
+    bt = layer_cache["block_tables"]
+    B, max_pages = bt.shape
+    _, Hkv, ps, D = layer_cache["k_pages"].shape
+
+    def gather(pages):
+        g = jnp.take(pages, bt, axis=0)             # [B, mp, Hkv, ps, D]
+        return g.transpose(0, 1, 3, 2, 4).reshape(B, max_pages * ps, Hkv, D)
+
+    return gather(layer_cache["k_pages"]), gather(layer_cache["v_pages"])
+
+
+def is_paged(layer_cache: Optional[dict]) -> bool:
+    return layer_cache is not None and "k_pages" in layer_cache

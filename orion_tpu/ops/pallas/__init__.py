@@ -1,0 +1,8 @@
+"""Pallas TPU kernels — the native-code layer (SURVEY.md §2 #13).
+
+These are the TPU-native equivalents of the reference stack's CUDA
+kernels: flash attention (fwd/bwd) for training and paged/ragged decode
+attention for the rollout engine.  On non-TPU backends (the CPU test
+harness) every kernel runs in Pallas interpret mode, so the whole suite
+is testable without hardware.
+"""

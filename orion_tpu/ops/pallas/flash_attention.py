@@ -1,0 +1,310 @@
+"""Pallas flash attention, forward + backward (SURVEY.md §2 #13).
+
+TPU-native equivalent of the reference stack's flash-attention CUDA
+kernels.  Design:
+
+- Public layout [B, L, H, D] (matching the model); internally the
+  wrapper transposes to [B, H, L, D] so every block's trailing two dims
+  are (seq-block, head-dim) — the shape Mosaic requires to tile onto
+  the MXU (last two block dims must be ÷8/÷128 or full).
+- The grid is (batch, q-head, q-block) and BlockSpec index maps pick
+  the matching KV head (``h // n_rep``), so GQA needs no materialized
+  ``repeat_kv``.
+- Masking is positional, matching the model's semantics exactly
+  (models/transformer.py Attention): query with absolute position p
+  attends to KV slot j iff ``j <= p``.  Causal training, chunked
+  prefill and ragged decode all reduce to this one rule, so the kernel
+  takes ``q_positions`` [B, Lq] instead of a dense [B, Lq, Lk] mask
+  (which would be O(L^2) HBM traffic).
+- Online softmax in f32 over KV blocks (VPU); QK^T and PV on the MXU
+  with ``preferred_element_type=f32``.
+- Backward is the standard two-kernel flash split: dQ over q-blocks,
+  dK/dV over kv-blocks, both recomputing P from the saved LSE.
+  For GQA the dK/dV kernel emits per-q-head gradients which are
+  group-summed outside the kernel.
+
+Interpret mode runs automatically off-TPU (CPU test harness).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+_NEG_INF = -1e30
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _pick_block(n: int, preferred: int) -> int:
+    for c in (preferred, 512, 256, 128, 64, 32, 16, 8, 4, 2, 1):
+        if c <= preferred and n % c == 0:
+            return c
+    return 1
+
+
+# ---------------------------------------------------------------------------
+# forward.  Internal layout: q/k/v/o [B, H, L, D]; qpos [B, Lq, 1];
+# lse [B, H, Lq, 1].
+# ---------------------------------------------------------------------------
+
+
+def _fwd_kernel(qpos_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *,
+                scale: float, blk_kv: int, kv_len: int):
+    blk_q, D = q_ref.shape[2], q_ref.shape[3]
+    q = q_ref[0, 0, :, :].astype(jnp.float32) * scale        # [bq, D]
+    qpos = qpos_ref[0, :, 0]                                  # [bq]
+
+    m0 = jnp.full((blk_q, 1), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((blk_q, 1), jnp.float32)
+    acc0 = jnp.zeros((blk_q, D), jnp.float32)
+
+    def body(i, carry):
+        m, l, acc = carry
+        k = k_ref[0, 0, pl.ds(i * blk_kv, blk_kv), :].astype(jnp.float32)
+        v = v_ref[0, 0, pl.ds(i * blk_kv, blk_kv), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)               # [bq, bkv]
+        kv_idx = i * blk_kv + jax.lax.broadcasted_iota(
+            jnp.int32, (blk_q, blk_kv), 1)
+        s = jnp.where(kv_idx <= qpos[:, None], s, _NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m - m_new)
+        l = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc = acc * alpha + jnp.dot(p, v,
+                                    preferred_element_type=jnp.float32)
+        return m_new, l, acc
+
+    # Causal block skipping: KV blocks entirely beyond the largest query
+    # position in this q-block are fully masked — stop the loop there.
+    n_blocks = jnp.minimum(jnp.max(qpos) // blk_kv + 1, kv_len // blk_kv)
+    m, l, acc = jax.lax.fori_loop(0, n_blocks, body, (m0, l0, acc0))
+    o_ref[0, 0, :, :] = (acc / l).astype(o_ref.dtype)
+    lse_ref[0, 0, :, 0] = m[:, 0] + jnp.log(l[:, 0])
+
+
+def _fwd(qt, kt, vt, qpos3, scale, blk_q, blk_kv):
+    """qt [B,H,Lq,D], kt/vt [B,Hkv,Lk,D], qpos3 [B,Lq,1]."""
+    B, H, Lq, D = qt.shape
+    Hkv, Lk = kt.shape[1], kt.shape[2]
+    n_rep = H // Hkv
+    bq = _pick_block(Lq, blk_q)
+    bkv = _pick_block(Lk, blk_kv)
+
+    out, lse = pl.pallas_call(
+        functools.partial(_fwd_kernel, scale=scale, blk_kv=bkv, kv_len=Lk),
+        grid=(B, H, Lq // bq),
+        in_specs=[
+            pl.BlockSpec((1, bq, 1), lambda b, h, i: (b, i, 0)),
+            pl.BlockSpec((1, 1, bq, D), lambda b, h, i: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, Lk, D),
+                         lambda b, h, i, r=n_rep: (b, h // r, 0, 0)),
+            pl.BlockSpec((1, 1, Lk, D),
+                         lambda b, h, i, r=n_rep: (b, h // r, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, bq, D), lambda b, h, i: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, bq, 1), lambda b, h, i: (b, h, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(qt.shape, qt.dtype),
+            jax.ShapeDtypeStruct((B, H, Lq, 1), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(qpos3, qt, kt, vt)
+    return out, lse
+
+
+# ---------------------------------------------------------------------------
+# backward
+# ---------------------------------------------------------------------------
+
+
+def _dq_kernel(qpos_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+               dq_ref, *, scale: float, blk_kv: int, kv_len: int):
+    blk_q, D = q_ref.shape[2], q_ref.shape[3]
+    q = q_ref[0, 0, :, :].astype(jnp.float32) * scale
+    do = do_ref[0, 0, :, :].astype(jnp.float32)
+    lse = lse_ref[0, 0, :, :]                                 # [bq, 1]
+    delta = delta_ref[0, 0, :, :]
+    qpos = qpos_ref[0, :, 0]
+
+    def body(i, dq):
+        k = k_ref[0, 0, pl.ds(i * blk_kv, blk_kv), :].astype(jnp.float32)
+        v = v_ref[0, 0, pl.ds(i * blk_kv, blk_kv), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        kv_idx = i * blk_kv + jax.lax.broadcasted_iota(
+            jnp.int32, (blk_q, blk_kv), 1)
+        mask = kv_idx <= qpos[:, None]
+        p = jnp.where(mask, jnp.exp(s - lse), 0.0)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        return dq + jnp.dot(ds, k, preferred_element_type=jnp.float32)
+
+    n_blocks = jnp.minimum(jnp.max(qpos) // blk_kv + 1, kv_len // blk_kv)
+    dq = jax.lax.fori_loop(
+        0, n_blocks, body, jnp.zeros((blk_q, D), jnp.float32))
+    dq_ref[0, 0, :, :] = (dq * scale).astype(dq_ref.dtype)
+
+
+def _dkv_kernel(qpos_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                dk_ref, dv_ref, *, scale: float, blk_q: int, q_len: int):
+    blk_kv, D = k_ref.shape[2], k_ref.shape[3]
+    k = k_ref[0, 0, :, :].astype(jnp.float32)
+    v = v_ref[0, 0, :, :].astype(jnp.float32)
+    j0 = pl.program_id(2) * blk_kv
+    kv_idx = j0 + jax.lax.broadcasted_iota(jnp.int32, (blk_q, blk_kv), 1)
+
+    def body(i, carry):
+        dk, dv = carry
+        sl = pl.ds(i * blk_q, blk_q)
+        q = q_ref[0, 0, sl, :].astype(jnp.float32) * scale
+        do = do_ref[0, 0, sl, :].astype(jnp.float32)
+        lse = lse_ref[0, 0, sl, :]                            # [bq, 1]
+        delta = delta_ref[0, 0, sl, :]
+        qpos = qpos_ref[0, sl, 0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)               # [bq, bkv]
+        mask = kv_idx <= qpos[:, None]
+        p = jnp.where(mask, jnp.exp(s - lse), 0.0)
+        dv = dv + jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)               # [bkv, D]
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)               # [bq, bkv]
+        ds = p * (dp - delta)
+        dk = dk + jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)               # [bkv, D]
+        return dk, dv
+
+    # Causal block skipping: q blocks whose largest position is below
+    # this kv block's start are fully masked.  Positions are monotonic
+    # (arange + per-seq offset), so count the rows below j0.
+    n_before = jnp.sum((qpos_ref[0, :, 0] < j0).astype(jnp.int32))
+    i_start = n_before // blk_q
+    z = jnp.zeros((blk_kv, D), jnp.float32)
+    dk, dv = jax.lax.fori_loop(i_start, q_len // blk_q, body, (z, z))
+    dk_ref[0, 0, :, :] = dk.astype(dk_ref.dtype)  # dk already carries `scale`
+    dv_ref[0, 0, :, :] = dv.astype(dv_ref.dtype)
+
+
+def _bwd_impl(qt, kt, vt, qpos3, scale, blk_q, blk_kv, out_t, lse, dout_t):
+    B, H, Lq, D = qt.shape
+    Hkv, Lk = kt.shape[1], kt.shape[2]
+    n_rep = H // Hkv
+    bq = _pick_block(Lq, blk_q)
+    bkv = _pick_block(Lk, blk_kv)
+
+    # delta[b, h, i] = rowsum(dO * O) — cheap elementwise, plain XLA.
+    delta = jnp.sum(dout_t.astype(jnp.float32) * out_t.astype(jnp.float32),
+                    axis=-1, keepdims=True)                   # [B, H, Lq, 1]
+
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, scale=scale, blk_kv=bkv, kv_len=Lk),
+        grid=(B, H, Lq // bq),
+        in_specs=[
+            pl.BlockSpec((1, bq, 1), lambda b, h, i: (b, i, 0)),
+            pl.BlockSpec((1, 1, bq, D), lambda b, h, i: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, Lk, D),
+                         lambda b, h, i, r=n_rep: (b, h // r, 0, 0)),
+            pl.BlockSpec((1, 1, Lk, D),
+                         lambda b, h, i, r=n_rep: (b, h // r, 0, 0)),
+            pl.BlockSpec((1, 1, bq, D), lambda b, h, i: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, bq, 1), lambda b, h, i: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, bq, 1), lambda b, h, i: (b, h, i, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, bq, D), lambda b, h, i: (b, h, i, 0)),
+        out_shape=jax.ShapeDtypeStruct(qt.shape, qt.dtype),
+        interpret=_interpret(),
+    )(qpos3, qt, kt, vt, dout_t, lse, delta)
+
+    # dK/dV per q-head, then group-sum the GQA repeats outside.
+    dk_h, dv_h = pl.pallas_call(
+        functools.partial(_dkv_kernel, scale=scale, blk_q=bq, q_len=Lq),
+        grid=(B, H, Lk // bkv),
+        in_specs=[
+            pl.BlockSpec((1, Lq, 1), lambda b, h, j: (b, 0, 0)),
+            pl.BlockSpec((1, 1, Lq, D), lambda b, h, j: (b, h, 0, 0)),
+            pl.BlockSpec((1, 1, bkv, D),
+                         lambda b, h, j, r=n_rep: (b, h // r, j, 0)),
+            pl.BlockSpec((1, 1, bkv, D),
+                         lambda b, h, j, r=n_rep: (b, h // r, j, 0)),
+            pl.BlockSpec((1, 1, Lq, D), lambda b, h, j: (b, h, 0, 0)),
+            pl.BlockSpec((1, 1, Lq, 1), lambda b, h, j: (b, h, 0, 0)),
+            pl.BlockSpec((1, 1, Lq, 1), lambda b, h, j: (b, h, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, bkv, D), lambda b, h, j: (b, h, j, 0)),
+            pl.BlockSpec((1, 1, bkv, D), lambda b, h, j: (b, h, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, H, Lk, D), jnp.float32),
+            jax.ShapeDtypeStruct((B, H, Lk, D), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(qpos3, qt, kt, vt, dout_t, lse, delta)
+
+    if n_rep > 1:
+        dk = dk_h.reshape(B, Hkv, n_rep, Lk, D).sum(axis=2)
+        dv = dv_h.reshape(B, Hkv, n_rep, Lk, D).sum(axis=2)
+    else:
+        dk, dv = dk_h, dv_h
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
+# public entry (custom VJP), model layout [B, L, H, D]
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
+def flash_attention_gqa(q, k, v, q_positions, scale,
+                        blk_q: int = 128, blk_kv: int = 128):
+    """Flash attention with positional causal masking.
+
+    q: [B, Lq, H, D]; k/v: [B, Lk, Hkv, D] (Hkv divides H);
+    q_positions: [B, Lq] int32 absolute positions — query at position p
+    attends to KV slots j <= p (identical semantics to the reference
+    attention mask built in models/transformer.py).
+    Returns [B, Lq, H, D] in q.dtype.
+    """
+    out, _ = _fwd(q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
+                  v.transpose(0, 2, 1, 3), q_positions[:, :, None],
+                  scale, blk_q, blk_kv)
+    return out.transpose(0, 2, 1, 3)
+
+
+def _vjp_fwd(q, k, v, q_positions, scale, blk_q, blk_kv):
+    qt = q.transpose(0, 2, 1, 3)
+    kt = k.transpose(0, 2, 1, 3)
+    vt = v.transpose(0, 2, 1, 3)
+    qpos3 = q_positions[:, :, None]
+    out_t, lse = _fwd(qt, kt, vt, qpos3, scale, blk_q, blk_kv)
+    return out_t.transpose(0, 2, 1, 3), (qt, kt, vt, qpos3, out_t, lse)
+
+
+def _vjp_bwd(scale, blk_q, blk_kv, residuals, dout):
+    qt, kt, vt, qpos3, out_t, lse = residuals
+    dq, dk, dv = _bwd_impl(qt, kt, vt, qpos3, scale, blk_q, blk_kv,
+                           out_t, lse, dout.transpose(0, 2, 1, 3))
+    return (dq.transpose(0, 2, 1, 3),
+            dk.transpose(0, 2, 1, 3).astype(kt.dtype),
+            dv.transpose(0, 2, 1, 3).astype(vt.dtype),
+            None)
+
+
+flash_attention_gqa.defvjp(_vjp_fwd, _vjp_bwd)

@@ -1,0 +1,121 @@
+"""Pallas paged-KV decode attention (SURVEY.md §2 #5, #13).
+
+TPU-native equivalent of vLLM's CUDA paged-attention decode kernel: one
+query token per sequence attends to that sequence's KV scattered across
+fixed-size pages of a global pool, addressed through a block table.
+
+Design: the grid is (batch, q-head, page-slot) and the page lookup
+happens in the *BlockSpec index map* from a scalar-prefetched block
+table (``PrefetchScalarGridSpec``) — Pallas's pipeline machinery then
+double-buffers the page DMAs automatically, which is the Mosaic-idiomatic
+version of the hand-rolled MultiPageAsyncCopyDescriptor pattern.
+Online softmax accumulates across page-slots in VMEM scratch (the grid's
+innermost dimension is sequential on TPU, so scratch persists).
+
+Padding rule: unused block-table slots must repeat the *last real page*
+(or any constant page id) — consecutive identical block indices skip
+the re-fetch, so the masked tail costs no HBM bandwidth.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = -1e30
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _decode_kernel(bt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
+                   m_sc, l_sc, acc_sc, *, scale: float, page_size: int):
+    b = pl.program_id(0)
+    j = pl.program_id(2)
+    last = pl.num_programs(2) - 1
+    seq_len = len_ref[b]
+
+    @pl.when(j == 0)
+    def _():
+        m_sc[:] = jnp.full_like(m_sc, _NEG_INF)
+        l_sc[:] = jnp.zeros_like(l_sc)
+        acc_sc[:] = jnp.zeros_like(acc_sc)
+
+    @pl.when(j * page_size < seq_len)
+    def _():
+        q = q_ref[0, 0, :, :].astype(jnp.float32) * scale        # [1, D]
+        k = k_ref[0, 0, :, :].astype(jnp.float32)                # [ps, D]
+        v = v_ref[0, 0, :, :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)                  # [1, ps]
+        idx = j * page_size + jax.lax.broadcasted_iota(
+            jnp.int32, (1, page_size), 1)
+        s = jnp.where(idx < seq_len, s, _NEG_INF)
+        # All (1, 1)-shaped vector ops: Mosaic VMEM cannot store scalars.
+        m_prev, l_prev = m_sc[:, :], l_sc[:, :]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)                                   # [1, ps]
+        alpha = jnp.exp(m_prev - m_new)
+        m_sc[:, :] = m_new
+        l_sc[:, :] = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_sc[:, :] = acc_sc[:, :] * alpha + jnp.dot(
+            p, v, preferred_element_type=jnp.float32)            # [1, D]
+
+    @pl.when(j == last)
+    def _():
+        o_ref[0, 0, :, :] = (acc_sc[:, :] /
+                             jnp.maximum(l_sc[:, :], 1e-30)).astype(o_ref.dtype)
+
+
+def paged_decode_attention(q: jnp.ndarray, k_pages: jnp.ndarray,
+                           v_pages: jnp.ndarray, block_tables: jnp.ndarray,
+                           seq_lens: jnp.ndarray, scale: float) -> jnp.ndarray:
+    """One decode step of attention over a paged KV pool.
+
+    q: [B, H, D] (current token per sequence);
+    k_pages/v_pages: [num_pages, Hkv, page_size, D] global pool (heads
+      before slots so page blocks tile as (slots, head_dim) on the MXU);
+    block_tables: [B, max_pages] int32, entry j = pool page holding
+      tokens [j*page_size, (j+1)*page_size) of that sequence;
+    seq_lens: [B] int32 — number of valid tokens (inclusive of the
+      current one).  Returns [B, H, D] in q.dtype.
+    """
+    B, H, D = q.shape
+    _, Hkv, page_size, _ = k_pages.shape
+    max_pages = block_tables.shape[1]
+    n_rep = H // Hkv
+    q4 = q[:, :, None, :]                                     # [B, H, 1, D]
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B, H, max_pages),
+        in_specs=[
+            pl.BlockSpec((1, 1, 1, D), lambda b, h, j, bt, ln: (b, h, 0, 0)),
+            pl.BlockSpec(
+                (1, 1, page_size, D),
+                lambda b, h, j, bt, ln, r=n_rep: (bt[b, j], h // r, 0, 0)),
+            pl.BlockSpec(
+                (1, 1, page_size, D),
+                lambda b, h, j, bt, ln, r=n_rep: (bt[b, j], h // r, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, 1, D),
+                               lambda b, h, j, bt, ln: (b, h, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((1, 1), jnp.float32),   # running max
+            pltpu.VMEM((1, 1), jnp.float32),   # running sumexp
+            pltpu.VMEM((1, D), jnp.float32),   # running accumulator
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(_decode_kernel, scale=scale, page_size=page_size),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, H, 1, D), q.dtype),
+        interpret=_interpret(),
+    )(block_tables, seq_lens, q4, k_pages, v_pages)
+    return out[:, :, 0, :]

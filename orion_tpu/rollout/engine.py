@@ -93,8 +93,17 @@ class RolloutEngine:
         sample = partial(sample_tokens, temperature=cfg.temperature,
                          top_k=cfg.top_k, top_p=cfg.top_p)
 
-        cache = init_cache(self.model_cfg, B, P + T,
-                           dtype=jnp.dtype(self.model_cfg.dtype))
+        if cfg.paged:
+            from orion_tpu.ops.paged_kv import init_paged_cache
+
+            mc = self.model_cfg
+            cache = init_paged_cache(
+                mc.num_layers, B, P + T, mc.num_kv_heads, mc.head_dim,
+                cfg.page_size, cfg.num_pages,
+                dtype=jnp.dtype(mc.dtype))
+        else:
+            cache = init_cache(self.model_cfg, B, P + T,
+                               dtype=jnp.dtype(self.model_cfg.dtype))
         positions = jnp.broadcast_to(jnp.arange(P, dtype=jnp.int32), (B, P))
         logits, cache = self.model.apply(
             {"params": params}, prompt_ids, positions, cache)
