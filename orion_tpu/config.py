@@ -185,6 +185,23 @@ class RolloutConfig:
 
 
 @dataclass
+class DataConfig:
+    """Prompt data source (SURVEY.md §2 #15).
+
+    dataset: "synthetic" (offline arithmetic, zero deps) | "tldr" |
+    "hh" | "ultrafeedback" | "gsm8k" | any HF dataset with a "prompt"
+    column.  tokenizer: HF path, or None/"byte" for the byte fallback.
+    """
+
+    dataset: str = "synthetic"
+    split: str = "train"
+    tokenizer: Optional[str] = None
+    use_chat_template: bool = False
+    system_prompt: Optional[str] = None
+    synthetic_size: int = 512
+
+
+@dataclass
 class TrainConfig:
     """Common trainer settings shared by all algorithms."""
 
@@ -193,6 +210,15 @@ class TrainConfig:
     mesh: MeshConfig = field(default_factory=MeshConfig)
     optimizer: OptimizerConfig = field(default_factory=OptimizerConfig)
     rollout: RolloutConfig = field(default_factory=RolloutConfig)
+    data: DataConfig = field(default_factory=DataConfig)
+    # Policy init: HF checkpoint path (None => random init), or a
+    # ModelConfig preset name ("llama3_8b"|"llama3_1b"|"pythia_1b") that
+    # overrides `model` wholesale.
+    hf_path: Optional[str] = None
+    model_preset: Optional[str] = None
+    # Reward source: "math" (rule verifier), "length" (debug),
+    # "model:<hf-or-ckpt-path>" (reward model scoring).
+    reward: str = "math"
 
     total_iterations: int = 100
     # Experience batch: prompts per iteration; optimization runs
@@ -211,7 +237,9 @@ class TrainConfig:
     # Checkpointing / logging.
     checkpoint_dir: Optional[str] = None
     checkpoint_every: int = 0  # 0 => disabled
+    checkpoint_keep: int = 3
     log_every: int = 1
+    log_dir: Optional[str] = None  # jsonl (+tensorboard) metrics stream
     # Async mode (SPEC config 4).
     async_mode: bool = False
     async_staleness: int = 1  # max steps rollout weights may lag

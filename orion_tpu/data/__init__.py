@@ -1,0 +1,6 @@
+from orion_tpu.data.prompts import (  # noqa: F401
+    ByteTokenizer,
+    PromptIterator,
+    build_prompt_iterator,
+    load_prompt_records,
+)

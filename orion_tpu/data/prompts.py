@@ -1,0 +1,258 @@
+"""Prompt data layer (SURVEY.md §2 #15): dataset adapters for the five
+SPEC configs — TL;DR summarization, HH-RLHF, UltraFeedback, GSM8K/MATH —
+plus a synthetic offline generator, all behind one checkpointable
+iterator.
+
+Offline-first: this box has zero egress, so `datasets.load_dataset`
+only works from a local cache/path.  Every adapter raises a clear error
+pointing at the synthetic fallback when the data isn't on disk; tests
+and smoke runs use ``dataset="synthetic"`` which needs nothing.
+
+Host-side by design: tokenization/padding happen on CPU while the TPU
+runs the previous batch (the same split the reference makes by keeping
+its dataloader workers off the GPU).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, Iterator, List, Optional
+
+import numpy as np
+
+
+# ---------------------------------------------------------------------------
+# Tokenizer adapters
+# ---------------------------------------------------------------------------
+
+
+class ByteTokenizer:
+    """Dependency-free fallback tokenizer (UTF-8 bytes + offset).
+
+    ids 0..3 reserved: 0 pad, 1 bos, 2 eos, 3 unk; byte b -> 4 + b.
+    Good enough for tests and synthetic smoke runs; real runs pass a
+    HF tokenizer path.
+    """
+
+    vocab_size = 260
+    pad_token_id = 0
+    bos_token_id = 1
+    eos_token_id = 2
+
+    def encode(self, text: str) -> List[int]:
+        return [1] + [4 + b for b in text.encode("utf-8")]
+
+    def decode(self, ids) -> str:
+        return bytes(int(i) - 4 for i in ids
+                     if 4 <= int(i) < 260).decode("utf-8", errors="replace")
+
+    def batch_decode(self, batch) -> List[str]:
+        return [self.decode(row) for row in batch]
+
+
+def load_tokenizer(name_or_path: Optional[str]):
+    """HF AutoTokenizer from a local path/cache, else ByteTokenizer."""
+    if not name_or_path or name_or_path == "byte":
+        return ByteTokenizer()
+    from transformers import AutoTokenizer
+
+    tok = AutoTokenizer.from_pretrained(name_or_path)
+    if tok.pad_token_id is None:
+        tok.pad_token = tok.eos_token
+    return tok
+
+
+def render_chat(tokenizer, user_content: str,
+                system: Optional[str] = None) -> str:
+    """Chat templating: tokenizer's template when it has one, else a
+    minimal two-role fallback."""
+    msgs = ([{"role": "system", "content": system}] if system else []) + \
+        [{"role": "user", "content": user_content}]
+    tmpl = getattr(tokenizer, "apply_chat_template", None)
+    if tmpl is not None and getattr(tokenizer, "chat_template", None):
+        return tokenizer.apply_chat_template(
+            msgs, tokenize=False, add_generation_prompt=True)
+    parts = [f"<|{m['role']}|>\n{m['content']}" for m in msgs]
+    return "\n".join(parts) + "\n<|assistant|>\n"
+
+
+# ---------------------------------------------------------------------------
+# Dataset adapters → list of records {"prompt": str, **meta}
+# ---------------------------------------------------------------------------
+
+
+def _load_hf(name: str, split: str, **kw):
+    try:
+        import datasets
+
+        return datasets.load_dataset(name, split=split, **kw)
+    except Exception as e:  # no network, no cache
+        raise RuntimeError(
+            f"dataset {name!r} is not available offline ({e}); either "
+            "pre-download it into the HF cache or use "
+            "dataset='synthetic'") from e
+
+
+def _records_tldr(split: str) -> List[dict]:
+    """TL;DR summarization prompts (SPEC configs 1-2).  Canonical HF
+    mirror: trl-lib/tldr (prompt/completion columns)."""
+    ds = _load_hf("trl-lib/tldr", split)
+    return [{"prompt": r["prompt"]} for r in ds]
+
+
+def _records_hh(split: str) -> List[dict]:
+    """HH-RLHF single-turn prompts (SPEC config 2).  Anthropic/hh-rlhf
+    rows are full dialogues; the prompt is everything up to the last
+    'Assistant:' turn."""
+    ds = _load_hf("Anthropic/hh-rlhf", split)
+    out = []
+    for r in ds:
+        text = r["chosen"]
+        cut = text.rfind("\n\nAssistant:")
+        if cut > 0:
+            out.append({"prompt": text[: cut + len("\n\nAssistant:")]})
+    return out
+
+
+def _records_ultrafeedback(split: str) -> List[dict]:
+    """UltraFeedback prompts (SPEC config 3, Online-DPO/RLOO)."""
+    ds = _load_hf("HuggingFaceH4/ultrafeedback_binarized", split)
+    return [{"prompt": r["prompt"]} for r in ds]
+
+
+def _records_gsm8k(split: str) -> List[dict]:
+    """GSM8K questions + gold numeric answer (SPEC config 5, GRPO)."""
+    ds = _load_hf("openai/gsm8k", split, name="main")
+    out = []
+    for r in ds:
+        ans = r["answer"].split("####")[-1].strip()
+        out.append({"prompt": r["question"], "answer": ans})
+    return out
+
+
+def _records_synthetic(n: int = 512, seed: int = 0) -> List[dict]:
+    """Arithmetic word problems with verifiable answers — exercises the
+    full GRPO pipeline (including the math verifier) fully offline."""
+    rng = np.random.RandomState(seed)
+    out = []
+    for _ in range(n):
+        a, b = int(rng.randint(2, 99)), int(rng.randint(2, 99))
+        op = rng.choice(["+", "-", "*"])
+        ans = {"+": a + b, "-": a - b, "*": a * b}[op]
+        out.append({"prompt": f"Compute {a} {op} {b}. Answer: ",
+                    "answer": str(ans)})
+    return out
+
+
+_ADAPTERS: Dict[str, Callable] = {
+    "tldr": _records_tldr,
+    "hh": _records_hh,
+    "ultrafeedback": _records_ultrafeedback,
+    "gsm8k": _records_gsm8k,
+}
+
+
+def load_prompt_records(dataset: str, split: str = "train",
+                        synthetic_size: int = 512,
+                        seed: int = 0) -> List[dict]:
+    if dataset == "synthetic":
+        return _records_synthetic(synthetic_size, seed)
+    if dataset in _ADAPTERS:
+        return _ADAPTERS[dataset](split)
+    # Unknown name: treat as a HF dataset with a "prompt" column.
+    ds = _load_hf(dataset, split)
+    return [{"prompt": r["prompt"]} for r in ds]
+
+
+# ---------------------------------------------------------------------------
+# Checkpointable batch iterator
+# ---------------------------------------------------------------------------
+
+
+class PromptIterator:
+    """Shuffled epoch iterator over tokenized prompts.
+
+    Yields {"prompt_ids" [B, P] int32, "prompt_lens" [B] int32, **meta}
+    (meta arrays of dtype object/str carry e.g. gold answers).
+    ``state()``/``load_state()`` capture (epoch, cursor, seed) so resume
+    is deterministic (SURVEY.md §5 failure recovery).
+    """
+
+    def __init__(self, records: List[dict], tokenizer, batch_size: int,
+                 max_prompt_len: int, seed: int = 0,
+                 use_chat_template: bool = False,
+                 system_prompt: Optional[str] = None):
+        if not records:
+            raise ValueError("no prompt records")
+        self.records = records
+        self.tokenizer = tokenizer
+        self.batch_size = batch_size
+        self.max_prompt_len = max_prompt_len
+        self.use_chat_template = use_chat_template
+        self.system_prompt = system_prompt
+        self.seed = seed
+        self.epoch = 0
+        self.cursor = 0
+        self._perm = self._make_perm()
+
+    def _make_perm(self) -> np.ndarray:
+        return np.random.RandomState(self.seed + self.epoch).permutation(
+            len(self.records))
+
+    # -- checkpointable state ------------------------------------------
+    def state(self) -> dict:
+        return {"epoch": self.epoch, "cursor": self.cursor,
+                "seed": self.seed}
+
+    def load_state(self, state: dict) -> None:
+        self.epoch = int(state["epoch"])
+        self.cursor = int(state["cursor"])
+        self.seed = int(state["seed"])
+        self._perm = self._make_perm()
+
+    # -- iteration ------------------------------------------------------
+    def _encode(self, prompt: str) -> List[int]:
+        if self.use_chat_template:
+            prompt = render_chat(self.tokenizer, prompt, self.system_prompt)
+        ids = self.tokenizer.encode(prompt)
+        return ids[-self.max_prompt_len:]  # keep the tail (the question)
+
+    def __iter__(self) -> Iterator[dict]:
+        return self
+
+    def __next__(self) -> dict:
+        take: List[dict] = []
+        while len(take) < self.batch_size:
+            if self.cursor >= len(self._perm):
+                self.epoch += 1
+                self.cursor = 0
+                self._perm = self._make_perm()
+            take.append(self.records[self._perm[self.cursor]])
+            self.cursor += 1
+
+        P = self.max_prompt_len
+        ids = np.zeros((self.batch_size, P), np.int32)
+        lens = np.zeros((self.batch_size,), np.int32)
+        meta: Dict[str, list] = {}
+        for i, rec in enumerate(take):
+            toks = self._encode(rec["prompt"])
+            ids[i, : len(toks)] = toks
+            lens[i] = len(toks)
+            for key, value in rec.items():
+                if key != "prompt":
+                    meta.setdefault(key, []).append(value)
+        batch = {"prompt_ids": ids, "prompt_lens": lens}
+        for key, values in meta.items():
+            batch[key] = np.asarray(values)
+        return batch
+
+
+def build_prompt_iterator(dataset: str, tokenizer, batch_size: int,
+                          max_prompt_len: int, split: str = "train",
+                          seed: int = 0, use_chat_template: bool = False,
+                          system_prompt: Optional[str] = None,
+                          synthetic_size: int = 512) -> PromptIterator:
+    records = load_prompt_records(dataset, split, synthetic_size, seed)
+    return PromptIterator(records, tokenizer, batch_size, max_prompt_len,
+                          seed=seed, use_chat_template=use_chat_template,
+                          system_prompt=system_prompt)

@@ -1,0 +1,162 @@
+"""Training entrypoint (SURVEY.md §2 #16, layer map "CLI / launch").
+
+Usage:
+  python -m orion_tpu.launch <algo> [--config cfg.yaml] [key=value ...]
+  algo ∈ {ppo, grpo, rloo, online_dpo}
+
+Examples (the five SPEC configs, BASELINE.json):
+  # 5: GRPO math with rule-based reward, fully offline
+  python -m orion_tpu.launch grpo data.dataset=synthetic reward=math \
+      total_iterations=20
+  # 1: Pythia-1B PPO on TL;DR (needs local HF caches)
+  python -m orion_tpu.launch ppo model_preset=pythia_1b \
+      hf_path=/path/to/pythia-1b data.dataset=tldr \
+      data.tokenizer=/path/to/pythia-1b reward=model:/path/to/rm
+  # 4: async decoupled rollout/learner
+  python -m orion_tpu.launch grpo async_mode=true rollout_devices=4
+
+Multi-host bring-up: set JAX_COORDINATOR/process env and
+``jax.distributed.initialize()`` runs before mesh construction.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from orion_tpu.config import (GRPOConfig, ModelConfig, OnlineDPOConfig,
+                              PPOConfig, RLOOConfig, load_config)
+from orion_tpu.data import build_prompt_iterator
+from orion_tpu.data.prompts import load_tokenizer
+from orion_tpu.models import (ScalarHeadModel, Transformer)
+from orion_tpu.models.hf_loader import load_hf_pretrained
+from orion_tpu.models.sharded import make_sharded_model
+from orion_tpu.parallel.mesh import make_mesh
+from orion_tpu.rewards import MathVerifierReward, ModelReward
+from orion_tpu.trainers import (GRPOTrainer, OnlineDPOTrainer, PPOTrainer,
+                                RLOOTrainer)
+
+ALGOS = {
+    "ppo": (PPOConfig, PPOTrainer),
+    "grpo": (GRPOConfig, GRPOTrainer),
+    "rloo": (RLOOConfig, RLOOTrainer),
+    "online_dpo": (OnlineDPOConfig, OnlineDPOTrainer),
+}
+
+_INIT_ARGS = (jnp.zeros((1, 2), jnp.int32), jnp.zeros((1, 2), jnp.int32))
+
+
+def build_reward(cfg, tokenizer, mesh):
+    spec = cfg.reward
+    if spec == "math":
+        # decode_fn receives ragged per-sequence token lists.
+        return MathVerifierReward(tokenizer.batch_decode)
+    if spec == "length":
+        max_new = cfg.rollout.max_new_tokens
+
+        def length_reward(result, meta):
+            return np.asarray(result.completion_lens, np.float32) / max_new
+
+        return length_reward
+    if spec.startswith("model:"):
+        # SPEC config 2: separate reward model scored as an XLA forward
+        # program on the same mesh (SURVEY.md §2 #6).
+        path = spec.split(":", 1)[1]
+        from orion_tpu.models.hf_loader import (config_from_hf,
+                                                load_hf_scalar_model)
+        from transformers import AutoConfig
+
+        rm_cfg = config_from_hf(AutoConfig.from_pretrained(path))
+        rm = ScalarHeadModel(rm_cfg)
+        host = load_hf_scalar_model(path, rm_cfg)
+        params, _ = make_sharded_model(rm, mesh, jax.random.key(1),
+                                       _INIT_ARGS, host_params=host)
+        return ModelReward(rm, params)
+    raise ValueError(f"unknown reward spec: {spec!r}")
+
+
+def build_trainer(algo: str, cfg, mesh, tokenizer):
+    _, trainer_cls = ALGOS[algo]
+    model = Transformer(cfg.model)
+    rng = jax.random.key(cfg.seed)
+    host = load_hf_pretrained(cfg.hf_path, cfg.model) if cfg.hf_path else None
+    params, _ = make_sharded_model(model, mesh, rng, _INIT_ARGS,
+                                   host_params=host)
+    reward_fn = build_reward(cfg, tokenizer, mesh)
+    eos = getattr(tokenizer, "eos_token_id", None)
+    pad = getattr(tokenizer, "pad_token_id", 0) or 0
+    kw = dict(reward_fn=reward_fn, eos_token_id=eos, pad_token_id=pad)
+    if algo == "ppo":
+        critic = ScalarHeadModel(cfg.model)
+        critic_params, _ = make_sharded_model(
+            critic, mesh, jax.random.fold_in(rng, 1), _INIT_ARGS)
+        return trainer_cls(cfg, model, params, critic, critic_params, **kw)
+    return trainer_cls(cfg, model, params, **kw)
+
+
+def main(argv: Optional[list] = None) -> Any:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if not argv or argv[0] not in ALGOS:
+        print(f"usage: python -m orion_tpu.launch {{{'|'.join(ALGOS)}}} "
+              "[--config cfg.yaml] [key=value ...]", file=sys.stderr)
+        raise SystemExit(2)
+    algo = argv.pop(0)
+    yaml_path = None
+    if "--config" in argv:
+        i = argv.index("--config")
+        yaml_path = argv[i + 1]
+        del argv[i:i + 2]
+    cfg_cls, _ = ALGOS[algo]
+    cfg = load_config(cfg_cls, yaml_path=yaml_path, cli_args=argv)
+    if cfg.model_preset:
+        cfg.model = getattr(ModelConfig, cfg.model_preset)()
+
+    if os.environ.get("JAX_COORDINATOR_ADDRESS"):
+        jax.distributed.initialize()
+
+    tokenizer = load_tokenizer(cfg.data.tokenizer)
+    if cfg.data.tokenizer in (None, "byte"):
+        cfg.model.vocab_size = max(cfg.model.vocab_size, 260)
+    else:
+        tok_vocab = len(tokenizer)
+        if tok_vocab > cfg.model.vocab_size:
+            # XLA gather clamps out-of-range ids silently — training on
+            # garbage embeddings with no error.  Fail loudly instead.
+            raise ValueError(
+                f"tokenizer vocab {tok_vocab} exceeds model.vocab_size "
+                f"{cfg.model.vocab_size}; set model_preset/hf_path or "
+                "model.vocab_size to match the tokenizer")
+
+    prompt_iter = build_prompt_iterator(
+        cfg.data.dataset, tokenizer, cfg.rollout_batch_size,
+        cfg.rollout.max_prompt_len, split=cfg.data.split, seed=cfg.seed,
+        use_chat_template=cfg.data.use_chat_template,
+        system_prompt=cfg.data.system_prompt,
+        synthetic_size=cfg.data.synthetic_size)
+
+    if cfg.async_mode:
+        from orion_tpu.orchestration import AsyncOrchestrator, split_devices
+
+        n_roll = cfg.rollout_devices or max(1, len(jax.devices()) // 2)
+        rollout_devs, train_devs = split_devices(jax.devices(), n_roll)
+        mesh = make_mesh(cfg.mesh, devices=train_devs)
+        with mesh:
+            trainer = build_trainer(algo, cfg, mesh, tokenizer)
+            trainer.resume(prompt_iter)
+            orch = AsyncOrchestrator(trainer, rollout_devs)
+            return orch.train(prompt_iter)
+
+    mesh = make_mesh(cfg.mesh)
+    with mesh:
+        trainer = build_trainer(algo, cfg, mesh, tokenizer)
+        trainer.resume(prompt_iter)
+        return trainer.train(prompt_iter)
+
+
+if __name__ == "__main__":
+    main()

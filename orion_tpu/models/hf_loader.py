@@ -46,12 +46,17 @@ def _lin(w: Any, bias: Any = None) -> Dict[str, np.ndarray]:
     return out
 
 
-def convert_hf_state_dict(sd: Mapping[str, Any], cfg: ModelConfig) -> dict:
+def convert_hf_state_dict(sd: Mapping[str, Any], cfg: ModelConfig,
+                          include_lm_head: bool = True) -> dict:
     if cfg.arch == "llama":
-        return _convert_llama(sd, cfg)
-    if cfg.arch == "neox":
-        return _convert_neox(sd, cfg)
-    raise ValueError(cfg.arch)
+        p = _convert_llama(sd, cfg)
+    elif cfg.arch == "neox":
+        p = _convert_neox(sd, cfg)
+    else:
+        raise ValueError(cfg.arch)
+    if not include_lm_head:
+        p.pop("lm_head", None)
+    return p
 
 
 def _convert_llama(sd: Mapping[str, Any], cfg: ModelConfig) -> dict:
@@ -130,8 +135,7 @@ def _convert_neox(sd: Mapping[str, Any], cfg: ModelConfig) -> dict:
     return p
 
 
-def load_hf_pretrained(path: str, cfg: ModelConfig) -> dict:
-    """Load a local HF safetensors checkpoint directory."""
+def _read_safetensors(path: str) -> Dict[str, np.ndarray]:
     from safetensors import safe_open
 
     files = sorted(glob.glob(os.path.join(path, "*.safetensors")))
@@ -142,7 +146,32 @@ def load_hf_pretrained(path: str, cfg: ModelConfig) -> dict:
         with safe_open(f, framework="np") as st:
             for k in st.keys():
                 sd[k] = st.get_tensor(k)
-    return convert_hf_state_dict(sd, cfg)
+    return sd
+
+
+def load_hf_pretrained(path: str, cfg: ModelConfig) -> dict:
+    """Load a local HF safetensors checkpoint directory."""
+    return convert_hf_state_dict(_read_safetensors(path), cfg)
+
+
+def load_hf_scalar_model(path: str, cfg: ModelConfig) -> dict:
+    """Params for ScalarHeadModel from a HF sequence-classification
+    checkpoint (reward model / critic init, SURVEY.md §2 #6-7).
+
+    Expects the usual ``score.weight`` [1, E] head; raises if absent —
+    a reward model with a random head would silently produce noise
+    scores, which is worse than failing.
+    """
+    sd = _read_safetensors(path)
+    head_key = next((k for k in ("score.weight", "v_head.weight",
+                                 "classifier.weight") if k in sd), None)
+    if head_key is None:
+        raise KeyError(
+            f"{path} has no scalar head (score.weight); not a "
+            "sequence-classification checkpoint")
+    backbone = convert_hf_state_dict(sd, cfg, include_lm_head=False)
+    return {"backbone": backbone,
+            "score_head": {"kernel": _np(sd[head_key]).T.copy()}}
 
 
 def config_from_hf(hf_cfg: Any) -> ModelConfig:

@@ -6,3 +6,14 @@ attention for the rollout engine.  On non-TPU backends (the CPU test
 harness) every kernel runs in Pallas interpret mode, so the whole suite
 is testable without hardware.
 """
+
+from __future__ import annotations
+
+import jax
+
+NEG_INF = -1e30
+
+
+def interpret_mode() -> bool:
+    """Run kernels interpreted off-TPU (CPU test harness)."""
+    return jax.default_backend() != "tpu"

@@ -10,11 +10,9 @@ table (``PrefetchScalarGridSpec``) — Pallas's pipeline machinery then
 double-buffers the page DMAs automatically, which is the Mosaic-idiomatic
 version of the hand-rolled MultiPageAsyncCopyDescriptor pattern.
 Online softmax accumulates across page-slots in VMEM scratch (the grid's
-innermost dimension is sequential on TPU, so scratch persists).
-
-Padding rule: unused block-table slots must repeat the *last real page*
-(or any constant page id) — consecutive identical block indices skip
-the re-fetch, so the masked tail costs no HBM bandwidth.
+innermost dimension is sequential on TPU, so scratch persists).  The
+page index map clamps to the last in-use page, so the masked tail of the
+block table costs no HBM bandwidth however it is padded.
 """
 
 from __future__ import annotations
@@ -26,11 +24,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-_NEG_INF = -1e30
-
-
-def _interpret() -> bool:
-    return jax.default_backend() != "tpu"
+from orion_tpu.ops.pallas import NEG_INF as _NEG_INF
+from orion_tpu.ops.pallas import interpret_mode as _interpret
 
 
 def _decode_kernel(bt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
@@ -92,17 +87,20 @@ def paged_decode_attention(q: jnp.ndarray, k_pages: jnp.ndarray,
     n_rep = H // Hkv
     q4 = q[:, :, None, :]                                     # [B, H, 1, D]
 
+    def page_map(b, h, j, bt, ln, r=n_rep, ps=page_size):
+        # Clamp to the last in-use page: steps beyond seq_len re-fetch
+        # the same page, which Pallas elides — the masked tail costs no
+        # HBM bandwidth regardless of how the table is padded.
+        last = jnp.maximum(ln[b] - 1, 0) // ps
+        return (bt[b, jnp.minimum(j, last)], h // r, 0, 0)
+
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(B, H, max_pages),
         in_specs=[
             pl.BlockSpec((1, 1, 1, D), lambda b, h, j, bt, ln: (b, h, 0, 0)),
-            pl.BlockSpec(
-                (1, 1, page_size, D),
-                lambda b, h, j, bt, ln, r=n_rep: (bt[b, j], h // r, 0, 0)),
-            pl.BlockSpec(
-                (1, 1, page_size, D),
-                lambda b, h, j, bt, ln, r=n_rep: (bt[b, j], h // r, 0, 0)),
+            pl.BlockSpec((1, 1, page_size, D), page_map),
+            pl.BlockSpec((1, 1, page_size, D), page_map),
         ],
         out_specs=pl.BlockSpec((1, 1, 1, D),
                                lambda b, h, j, bt, ln: (b, h, 0, 0)),

@@ -176,7 +176,10 @@ class AsyncOrchestrator:
         from orion_tpu.rollout import GenerationResult
 
         trainer = self.trainer
-        n = num_iterations or trainer.cfg.total_iterations
+        if num_iterations is not None:
+            n = num_iterations
+        else:  # same resume semantics as BaseTrainer.train
+            n = max(0, trainer.cfg.total_iterations - trainer.global_iter)
         # Reset for reuse: a prior train() call leaves _stop set and may
         # leave an undrained item behind.
         self._stop.clear()
@@ -208,6 +211,7 @@ class AsyncOrchestrator:
                     result, item.scores)
                 t1 = time.perf_counter()
                 stats = trainer.update_epochs(experience)
+                trainer.global_iter += 1
                 self._broadcast_weights()
                 with self._version_cv:
                     self._version += 1

@@ -109,6 +109,18 @@ class BaseTrainer:
         self._jit_logprobs = jax.jit(
             self._logprobs_fn, static_argnames=("max_new",))
         self._jit_update = jax.jit(self._update_fn, donate_argnums=(0,))
+        self.global_iter = 0
+        self.ckpt = None
+        if cfg.checkpoint_dir and cfg.checkpoint_every:
+            from orion_tpu.utils.checkpoint import CheckpointManager
+
+            self.ckpt = CheckpointManager(cfg.checkpoint_dir,
+                                          max_to_keep=cfg.checkpoint_keep)
+        self.writer = None
+        if cfg.log_dir:
+            from orion_tpu.utils.metrics import MetricsWriter
+
+            self.writer = MetricsWriter(cfg.log_dir)
 
     # ------------------------------------------------------------------
     # jitted helpers
@@ -235,12 +247,70 @@ class BaseTrainer:
         self.engine.load_weights(self.state.params)
 
     # ------------------------------------------------------------------
+    # checkpoint/resume (SURVEY.md §2 #17)
+    # ------------------------------------------------------------------
+    def _extra_state(self, prompt_iter=None) -> dict:
+        extra = {
+            "global_iter": self.global_iter,
+            "rng": np.asarray(jax.random.key_data(self._rng)).tolist(),
+            "np_rng": _np_state_to_json(self._np_rng.get_state()),
+        }
+        kl_ctl = getattr(self, "kl_ctl", None)
+        if kl_ctl is not None:
+            extra["kl_coef"] = float(kl_ctl.value)
+        if prompt_iter is not None and hasattr(prompt_iter, "state"):
+            extra["data"] = prompt_iter.state()
+        return extra
+
+    def save_checkpoint(self, prompt_iter=None) -> None:
+        if self.ckpt is None:
+            raise ValueError("configure checkpoint_dir + checkpoint_every")
+        self.ckpt.save(self.global_iter, self.state,
+                       critic_state=getattr(self, "critic_state", None),
+                       extra=self._extra_state(prompt_iter))
+
+    def resume(self, prompt_iter=None) -> bool:
+        """Restore the latest checkpoint if one exists.  Returns True if
+        training state was restored."""
+        if self.ckpt is None or self.ckpt.latest_step() is None:
+            return False
+        out = self.ckpt.restore(
+            state_template=self.state,
+            critic_template=getattr(self, "critic_state", None))
+        self.state = out["state"]
+        if "critic_state" in out and out["critic_state"] is not None:
+            self.critic_state = out["critic_state"]
+        extra = out.get("extra") or {}
+        self.global_iter = int(extra.get("global_iter", 0))
+        if "rng" in extra:
+            self._rng = jax.random.wrap_key_data(
+                jnp.asarray(extra["rng"], jnp.uint32))
+        if "np_rng" in extra:
+            self._np_rng.set_state(_np_state_from_json(extra["np_rng"]))
+        if "kl_coef" in extra and getattr(self, "kl_ctl", None) is not None:
+            self.kl_ctl.value = float(extra["kl_coef"])
+        if "data" in extra and prompt_iter is not None and \
+                hasattr(prompt_iter, "load_state"):
+            prompt_iter.load_state(extra["data"])
+        self.sync_weights()
+        return True
+
+    # ------------------------------------------------------------------
     def train(self, prompt_iter: Iterator[dict],
               num_iterations: Optional[int] = None) -> list:
-        """The outer loop (SURVEY.md §3a)."""
+        """The outer loop (SURVEY.md §3a).
+
+        ``num_iterations`` means "run this many more"; without it the
+        horizon is ``cfg.total_iterations`` *total*, counted by
+        ``global_iter`` — so a resumed run executes only the remaining
+        iterations and LR schedules stay on their decay horizon.
+        """
         import time
 
-        n = num_iterations or self.cfg.total_iterations
+        if num_iterations is not None:
+            n = num_iterations
+        else:
+            n = max(0, self.cfg.total_iterations - self.global_iter)
         for it in range(n):
             t0 = time.perf_counter()
             batch = next(prompt_iter)
@@ -257,12 +327,32 @@ class BaseTrainer:
                 "time_update_s": t2 - t1,
                 "samples_per_sec": n_samples / (t2 - t0),
             })
+            self.global_iter += 1
             self.metrics_history.append(stats)
+            if self.writer is not None:
+                self.writer.write(self.global_iter, stats)
             if self.cfg.log_every and it % self.cfg.log_every == 0:
                 self.log(stats)
+            if self.ckpt is not None and \
+                    self.global_iter % self.cfg.checkpoint_every == 0:
+                self.save_checkpoint(prompt_iter)
+        if self.ckpt is not None:
+            self.ckpt.wait()
         return self.metrics_history
 
     def log(self, stats: dict) -> None:
         keys = ("iteration", "reward_mean", "loss", "kl", "samples_per_sec")
         msg = " ".join(f"{k}={stats[k]:.4g}" for k in keys if k in stats)
         print(f"[orion-tpu] {msg}", flush=True)
+
+
+def _np_state_to_json(state: tuple) -> list:
+    name, keys, pos, has_gauss, cached = state
+    return [name, np.asarray(keys).tolist(), int(pos), int(has_gauss),
+            float(cached)]
+
+
+def _np_state_from_json(data: list) -> tuple:
+    name, keys, pos, has_gauss, cached = data
+    return (name, np.asarray(keys, np.uint32), int(pos), int(has_gauss),
+            float(cached))

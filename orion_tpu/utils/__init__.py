@@ -1,0 +1,2 @@
+from orion_tpu.utils.checkpoint import CheckpointManager  # noqa: F401
+from orion_tpu.utils.metrics import MetricsWriter  # noqa: F401

@@ -1,0 +1,99 @@
+"""Checkpoint/resume via Orbax (SURVEY.md §2 #17, §5).
+
+Saves the full training session — policy TrainState (params + optimizer
++ step), optional critic TrainState, KL-controller value, host RNG
+state, data-iterator state and metrics history — as one composite
+checkpoint per step, with retention and async write handled by Orbax's
+CheckpointManager.  Sharded arrays restore to their saved shardings by
+default (restore on the same mesh), or to target abstract shardings the
+caller passes for elastic reshape.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+import jax
+import numpy as np
+import orbax.checkpoint as ocp
+
+
+class CheckpointManager:
+    """Thin policy layer over ocp.CheckpointManager.
+
+    Items:
+      state        — policy TrainState pytree
+      critic_state — critic TrainState pytree (PPO) or absent
+      extra        — JSON-able dict (rng seeds, KL coef, iterator state,
+                     metrics tail)
+    """
+
+    def __init__(self, directory: str, max_to_keep: int = 3,
+                 async_save: bool = True):
+        self.directory = os.path.abspath(directory)
+        options = ocp.CheckpointManagerOptions(
+            max_to_keep=max_to_keep,
+            enable_async_checkpointing=async_save,
+        )
+        self._mgr = ocp.CheckpointManager(self.directory, options=options)
+
+    # ------------------------------------------------------------------
+    def save(self, step: int, state: Any, critic_state: Any = None,
+             extra: Optional[dict] = None) -> None:
+        items = {"state": ocp.args.StandardSave(state)}
+        if critic_state is not None:
+            items["critic_state"] = ocp.args.StandardSave(critic_state)
+        if extra is not None:
+            items["extra"] = ocp.args.JsonSave(_jsonable(extra))
+        self._mgr.save(step, args=ocp.args.Composite(**items))
+
+    def restore(self, step: Optional[int] = None, state_template: Any = None,
+                critic_template: Any = None) -> dict:
+        """Restore the latest (or given) step.  Templates are pytrees of
+        arrays (or ShapeDtypeStruct with shardings) matching what was
+        saved; pass the freshly-initialized TrainState."""
+        step = step if step is not None else self.latest_step()
+        if step is None:
+            raise FileNotFoundError(f"no checkpoint in {self.directory}")
+        items = {}
+        if state_template is not None:
+            items["state"] = ocp.args.StandardRestore(state_template)
+        if critic_template is not None:
+            items["critic_state"] = ocp.args.StandardRestore(critic_template)
+        items["extra"] = ocp.args.JsonRestore()
+        try:
+            out = self._mgr.restore(step, args=ocp.args.Composite(**items))
+        except Exception:
+            # checkpoint saved without `extra`
+            items.pop("extra")
+            out = self._mgr.restore(step, args=ocp.args.Composite(**items))
+        return dict(out)
+
+    def latest_step(self) -> Optional[int]:
+        return self._mgr.latest_step()
+
+    def wait(self) -> None:
+        """Block until in-flight async saves land (call before exit)."""
+        self._mgr.wait_until_finished()
+
+    def close(self) -> None:
+        self._mgr.wait_until_finished()
+        self._mgr.close()
+
+
+def _jsonable(tree: Any) -> Any:
+    """Best-effort conversion of config/metrics values to JSON types."""
+    if isinstance(tree, dict):
+        return {k: _jsonable(v) for k, v in tree.items()}
+    if isinstance(tree, (list, tuple)):
+        return [_jsonable(v) for v in tree]
+    if isinstance(tree, (np.integer,)):
+        return int(tree)
+    if isinstance(tree, (np.floating,)):
+        return float(tree)
+    if isinstance(tree, np.ndarray):
+        return tree.tolist()
+    if isinstance(tree, jax.Array):
+        return np.asarray(tree).tolist()
+    return tree

@@ -1,0 +1,53 @@
+"""Metrics/logging (SURVEY.md §2 #18, §5): scalar stream → jsonl file
+(always) + tensorboard event files via clu when available.
+
+The BASELINE metric — samples/sec (rollout+update) — is first-class:
+BaseTrainer computes it every iteration and this writer just persists
+whatever scalar dict it gets, so new metrics need no plumbing.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Optional
+
+
+class MetricsWriter:
+    """Append-only jsonl + optional tensorboard scalars."""
+
+    def __init__(self, directory: str, tensorboard: bool = True):
+        self.directory = os.path.abspath(directory)
+        os.makedirs(self.directory, exist_ok=True)
+        self._jsonl = open(os.path.join(self.directory, "metrics.jsonl"), "a")
+        self._tb = None
+        if tensorboard:
+            try:
+                from clu import metric_writers
+
+                self._tb = metric_writers.SummaryWriter(self.directory)
+            except Exception:
+                self._tb = None  # clu/tensorboard unavailable: jsonl only
+
+    def write(self, step: int, scalars: dict) -> None:
+        numeric = {k: float(v) for k, v in scalars.items()
+                   if isinstance(v, (int, float)) or _is_scalar_like(v)}
+        rec = {"step": int(step), "time": time.time(), **numeric}
+        self._jsonl.write(json.dumps(rec) + "\n")
+        self._jsonl.flush()
+        if self._tb is not None:
+            self._tb.write_scalars(int(step), numeric)
+
+    def close(self) -> None:
+        self._jsonl.close()
+        if self._tb is not None:
+            self._tb.flush()
+
+
+def _is_scalar_like(v) -> bool:
+    try:
+        float(v)
+        return getattr(v, "size", 1) == 1
+    except Exception:
+        return False
