@@ -50,15 +50,27 @@ def attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     Pallas flash attention over the positional rule
     ``kv_slot <= q_position`` (needs ``q_positions`` [B, Lq]).
 
-    CONTRACT: the flash path does NOT read ``mask`` — callers selecting
-    impl="flash" must guarantee mask ≡ (kv_slot <= q_position), which
-    holds for every mask built in models/transformer.py.  A mask with
-    extra structure (padding-aware, bidirectional, packed-segment)
-    requires impl="reference".  Decode steps (Lq == 1) always take the
-    reference path — a 1-row MXU tile would waste the systolic array;
-    the paged decode kernel covers that case from the rollout engine.
+    Sequence-parallel impls (must be called inside shard_map with the
+    "seq" mesh axis mapped; activations sharded on the sequence dim):
+    "ring" — ppermute KV rotation; "ulysses" — all_to_all head/seq swap.
+
+    CONTRACT: every non-"reference" path ignores ``mask`` and applies
+    the positional rule ``kv_position <= q_position`` — which holds for
+    every mask built in models/transformer.py.  A mask with extra
+    structure (padding-aware, bidirectional, packed-segment) requires
+    impl="reference".  Decode steps (Lq == 1) always take the reference
+    path — a 1-row MXU tile would waste the systolic array; the paged
+    decode kernel covers that case from the rollout engine.
     """
     n_rep = q.shape[2] // k.shape[2]
+    if impl in ("ring", "ulysses") and q.shape[1] > 1:
+        if q_positions is None:
+            raise ValueError(f"{impl} attention requires q_positions")
+        from orion_tpu.parallel.longctx import (ring_attention,
+                                                ulysses_attention)
+        if impl == "ring":
+            return ring_attention(q, k, v, q_positions, q_positions, scale)
+        return ulysses_attention(q, k, v, q_positions, scale)
     if impl == "flash" and q.shape[1] > 1:
         if q_positions is None:
             raise ValueError("flash attention requires q_positions")

@@ -1,0 +1,151 @@
+"""Long-context sequence/context parallelism (SURVEY.md §5, §2
+parallelism table: SP/CP rows).
+
+Two complementary schemes, both pure collective compositions that XLA
+lowers to ICI traffic — called from inside ``shard_map`` over the mesh's
+``seq`` axis:
+
+- **Ulysses** (:func:`ulysses_attention`): all_to_all swaps the sharded
+  axis from sequence to heads around attention — each device then holds
+  the FULL sequence for H/s heads, so the local attention is exact and
+  can use the Pallas flash kernel.  Cheap (two all_to_alls), bounded by
+  head count: needs ``H % s == 0 and Hkv % s == 0``.
+- **Ring attention** (:func:`ring_attention`): queries stay put; KV
+  chunks rotate around the ring via ``ppermute`` with streaming-softmax
+  accumulation, so no device ever materializes more than an
+  (Lq_local x Lk_local) score block.  Scales to arbitrary sequence
+  lengths and any head count.  Differentiable end to end (the transpose
+  of ppermute is the reverse ppermute, so autodiff yields the standard
+  ring-attention backward rotation for free).
+
+Causal load balance: with contiguous chunks, device s-1 does s times the
+causal work of device 0.  :func:`zigzag_sequence` reorders the sequence
+so device d holds chunks (d, 2s-1-d) — every device then sees the same
+masked-block count.  Both attention functions take absolute position
+arrays, so they are layout-agnostic; zigzag is just a host-side
+permutation of tokens + positions before sharding.
+
+Reference mechanism unknown (empty mount, SURVEY.md §0); these follow
+the public Ulysses / Ring-Attention formulations.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from orion_tpu.ops.attention import repeat_kv
+
+_NEG_INF = -1e30
+
+
+# ---------------------------------------------------------------------------
+# Ulysses: seq-shard -> head-shard -> attend -> back
+# ---------------------------------------------------------------------------
+
+
+def ulysses_attention(q, k, v, q_positions, scale: float,
+                      axis_name: str = "seq",
+                      impl: str = "reference") -> jnp.ndarray:
+    """Call inside shard_map with the sequence axis mapped.
+
+    q [B, Ls, H, D], k/v [B, Ls, Hkv, D], q_positions [B, Ls] — all
+    sharded on the sequence axis (Ls = L / s).  Returns [B, Ls, H, D].
+    """
+    from orion_tpu.ops.attention import attention
+
+    s = lax.axis_size(axis_name)
+    H, Hkv = q.shape[2], k.shape[2]
+    if H % s or Hkv % s:
+        raise ValueError(
+            f"ulysses needs seq axis {s} to divide heads {H} and kv "
+            f"heads {Hkv}; use ring_attention instead")
+    # [B, Ls, H, D] -> [B, L, H/s, D]: concat seq shards, split heads.
+    q = lax.all_to_all(q, axis_name, split_axis=2, concat_axis=1, tiled=True)
+    k = lax.all_to_all(k, axis_name, split_axis=2, concat_axis=1, tiled=True)
+    v = lax.all_to_all(v, axis_name, split_axis=2, concat_axis=1, tiled=True)
+    qpos = lax.all_gather(q_positions, axis_name, axis=1, tiled=True)
+
+    key_slots = jnp.arange(k.shape[1], dtype=qpos.dtype)
+    mask = key_slots[None, None, :] <= qpos[:, :, None]
+    out = attention(q, k, v, mask, scale=scale, impl=impl, q_positions=qpos)
+    # [B, L, H/s, D] -> [B, Ls, H, D]
+    return lax.all_to_all(out, axis_name, split_axis=1, concat_axis=2,
+                          tiled=True)
+
+
+# ---------------------------------------------------------------------------
+# Ring attention: KV rotates, queries stay
+# ---------------------------------------------------------------------------
+
+
+def ring_attention(q, k, v, q_positions, kv_positions, scale: float,
+                   axis_name: str = "seq") -> jnp.ndarray:
+    """Call inside shard_map with the sequence axis mapped.
+
+    q [B, Lq_loc, H, D]; k/v [B, Lk_loc, Hkv, D]; q_positions
+    [B, Lq_loc], kv_positions [B, Lk_loc] — absolute positions, any
+    layout (contiguous or zigzag).  Causality is positional:
+    kv_position <= q_position.  Returns [B, Lq_loc, H, D] in q.dtype.
+    """
+    s = lax.axis_size(axis_name)
+    B, Lq, H, D = q.shape
+    n_rep = H // k.shape[2]
+    qf = q.astype(jnp.float32) * scale
+    qpos = q_positions
+
+    m = jnp.full((B, H, Lq, 1), _NEG_INF, jnp.float32)
+    l = jnp.zeros((B, H, Lq, 1), jnp.float32)
+    acc = jnp.zeros((B, H, Lq, D), jnp.float32)
+    perm = [(i, (i + 1) % s) for i in range(s)]
+
+    for _ in range(s):
+        kk = repeat_kv(k, n_rep).astype(jnp.float32)
+        vv = repeat_kv(v, n_rep).astype(jnp.float32)
+        sc = jnp.einsum("bqhd,bkhd->bhqk", qf, kk,
+                        preferred_element_type=jnp.float32)
+        mask = kv_positions[:, None, None, :] <= qpos[:, None, :, None]
+        sc = jnp.where(mask, sc, _NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(sc, axis=-1, keepdims=True))
+        p = jnp.exp(sc - m_new)
+        alpha = jnp.exp(m - m_new)
+        l = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc = acc * alpha + jnp.einsum("bhqk,bkhd->bhqd", p, vv)
+        m = m_new
+        k = lax.ppermute(k, axis_name, perm)
+        v = lax.ppermute(v, axis_name, perm)
+        kv_positions = lax.ppermute(kv_positions, axis_name, perm)
+
+    out = acc / jnp.maximum(l, 1e-30)            # [B, H, Lq, D]
+    return out.transpose(0, 2, 1, 3).astype(q.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Zigzag layout helpers (host-side)
+# ---------------------------------------------------------------------------
+
+
+def zigzag_order(L: int, s: int) -> np.ndarray:
+    """Token order such that an even split over s devices gives device d
+    chunks (d, 2s-1-d) of the original sequence — equal causal work per
+    device.  Returns indices [L]: position j of the reordered sequence
+    holds original token zigzag_order[j]."""
+    if L % (2 * s):
+        raise ValueError(f"sequence {L} not divisible by 2*seq axis {2 * s}")
+    c = L // (2 * s)
+    chunks = []
+    for d in range(s):
+        chunks.append(np.arange(d * c, (d + 1) * c))
+        chunks.append(np.arange((2 * s - 1 - d) * c, (2 * s - d) * c))
+    return np.concatenate(chunks)
+
+
+def zigzag_inverse(L: int, s: int) -> np.ndarray:
+    order = zigzag_order(L, s)
+    inv = np.empty(L, np.int64)
+    inv[order] = np.arange(L)
+    return inv

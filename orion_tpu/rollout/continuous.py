@@ -1,0 +1,264 @@
+"""Continuous-batching generation engine (SURVEY.md §2 #5, §3c).
+
+TPU-native counterpart of vLLM's continuous batching: a fixed number of
+engine *slots* decode in lockstep inside jitted segments, while the
+native scheduler (orion_tpu/runtime) admits waiting requests into freed
+slots **between** segments — XLA's static-shape regime makes token-level
+admission impossible, so admission happens at segment granularity.
+
+Device state is one persistent paged-KV pool (per layer) + a block
+table; each slot's pages are assigned by the scheduler, so a retiring
+sequence's pages are recycled into the next admission with no cache
+reshuffling.  The per-segment jitted program is the same model decode
+step the simple engine uses (paged Pallas attention), batched over all
+slots; empty slots ride along masked.
+
+Flow per wave:
+  admit() -> prefill each admitted request (jitted, fixed prompt bucket)
+  -> decode segment of K tokens (jitted) -> harvest finished slots,
+  free their pages, loop.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Dict, Iterable, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from orion_tpu.config import ModelConfig, RolloutConfig
+from orion_tpu.ops.sampling import sample_tokens
+from orion_tpu.runtime import Scheduler
+
+
+@dataclasses.dataclass
+class CompletedRequest:
+    req_id: int
+    tokens: np.ndarray     # [n] completion token ids
+    logprobs: np.ndarray   # [n] sampling-dist logprobs (f32)
+
+
+class ContinuousBatchingEngine:
+    """Throughput-oriented generation over a stream of requests."""
+
+    def __init__(self, model, model_cfg: ModelConfig, cfg: RolloutConfig,
+                 eos_token_id: Optional[int] = None, pad_token_id: int = 0,
+                 segment_len: int = 16):
+        self.model = model
+        self.mc = model_cfg
+        self.cfg = cfg
+        self.eos = eos_token_id
+        self.pad = pad_token_id
+        self.segment_len = segment_len
+        self.slots = cfg.max_batch_size
+        ps = cfg.page_size
+        self.pages_per_seq = -(-(cfg.max_prompt_len + cfg.max_new_tokens)
+                               // ps)
+        self.num_pages = cfg.num_pages or self.slots * self.pages_per_seq
+        self.sched = Scheduler(self.num_pages, ps, self.slots)
+
+        # One extra scratch page (index num_pages): inactive/done slots
+        # point their whole block table at it, so their masked lockstep
+        # writes can never touch a live request's pages.
+        self._scratch = self.num_pages
+        shape = (self.num_pages + 1, model_cfg.num_kv_heads, ps,
+                 model_cfg.head_dim)
+        dt = jnp.dtype(model_cfg.dtype)
+        self._pools = [{"k_pages": jnp.zeros(shape, dt),
+                        "v_pages": jnp.zeros(shape, dt)}
+                       for _ in range(model_cfg.num_layers)]
+        self._bt = np.full((self.slots, self.pages_per_seq), self._scratch,
+                           np.int32)
+
+        self._jit_prefill = jax.jit(self._prefill_fn, donate_argnums=(1,))
+        self._jit_segment = jax.jit(self._segment_fn, donate_argnums=(1,),
+                                    static_argnames=("n_steps",))
+
+    # -- jitted programs ------------------------------------------------
+    def _cache(self, pools, bt):
+        return [{"k_pages": p["k_pages"], "v_pages": p["v_pages"],
+                 "block_tables": bt} for p in pools]
+
+    def _prefill_fn(self, params, pools, bt_row, prompt_ids, prompt_len,
+                    rng):
+        """One admitted request: fill its pages, sample token 0.
+
+        prompt_ids [1, Pmax] right-padded; bt_row [1, pages_per_seq].
+        Returns (pools, tok0 [1], lp0 [1], plp0 [1]).
+        """
+        P = prompt_ids.shape[1]
+        positions = jnp.arange(P, dtype=jnp.int32)[None, :]
+        cache = self._cache(pools, bt_row)
+        logits, cache = self.model.apply({"params": params}, prompt_ids,
+                                         positions, cache)
+        last = jnp.take_along_axis(
+            logits, (prompt_len - 1)[:, None, None], axis=1)[:, 0]
+        tok0, lp0, plp0 = sample_tokens(
+            rng, last, temperature=self.cfg.temperature,
+            top_k=self.cfg.top_k, top_p=self.cfg.top_p)
+        pools = [{"k_pages": c["k_pages"], "v_pages": c["v_pages"]}
+                 for c in cache]
+        return pools, tok0, lp0, plp0
+
+    def _segment_fn(self, params, pools, bt, cur_tok, lengths, done, rng,
+                    n_steps: int):
+        """Decode n_steps tokens for all slots in lockstep.
+
+        cur_tok [S] (token to feed), lengths [S] (tokens so far incl.
+        cur_tok's position), done [S] bool.  Returns (pools, tokens
+        [S, n], lps [S, n], plps [S, n], cur_tok, lengths, done).
+        """
+        S = cur_tok.shape[0]
+        pad = self.pad
+
+        def body(i, c):
+            pools, cur_tok, lengths, done, rng, toks, lps, plps = c
+            cache = self._cache(pools, bt)
+            # feed cur_tok at position lengths-1? No: cur_tok was sampled
+            # for position `lengths`; write it there and predict next.
+            positions = lengths[:, None]
+            logits, cache = self.model.apply(
+                {"params": params}, cur_tok[:, None], positions, cache)
+            rng, sub = jax.random.split(rng)
+            nxt, lp, plp = sample_tokens(
+                sub, logits[:, 0], temperature=self.cfg.temperature,
+                top_k=self.cfg.top_k, top_p=self.cfg.top_p)
+            nxt = jnp.where(done, pad, nxt)
+            lp = jnp.where(done, 0.0, lp)
+            plp = jnp.where(done, 0.0, plp)
+            toks = toks.at[:, i].set(nxt)
+            lps = lps.at[:, i].set(lp)
+            plps = plps.at[:, i].set(plp)
+            if self.eos is not None:
+                done = done | (nxt == self.eos)
+            lengths = lengths + 1  # the written position always advances
+            pools = [{"k_pages": c["k_pages"], "v_pages": c["v_pages"]}
+                     for c in cache]
+            return pools, nxt, lengths, done, rng, toks, lps, plps
+
+        toks = jnp.full((S, n_steps), pad, jnp.int32)
+        lps = jnp.zeros((S, n_steps), jnp.float32)
+        plps = jnp.zeros((S, n_steps), jnp.float32)
+        out = jax.lax.fori_loop(
+            0, n_steps, body,
+            (pools, cur_tok, lengths, done, rng, toks, lps, plps))
+        pools, cur_tok, lengths, done, rng, toks, lps, plps = out
+        return pools, toks, lps, plps, cur_tok, lengths, done
+
+    # -- host driver ----------------------------------------------------
+    def generate(self, requests: Iterable[Tuple[int, np.ndarray]],
+                 rng: jax.Array, params) -> List[CompletedRequest]:
+        """Run all requests to completion; returns them in finish order.
+
+        requests: iterable of (req_id, prompt_ids 1-D int array).
+        """
+        cfg = self.cfg
+        S = self.slots
+        requests = list(requests)  # may be a generator; we iterate twice
+        for req_id, ids in requests:
+            if len(ids) > cfg.max_prompt_len:
+                raise ValueError(f"prompt {req_id} longer than "
+                                 f"max_prompt_len={cfg.max_prompt_len}")
+            self.sched.add(req_id, len(ids), cfg.max_new_tokens)
+        prompts = {req_id: np.asarray(ids, np.int32)
+                   for req_id, ids in requests}
+
+        # host-side per-slot bookkeeping
+        slot_req = np.full(S, -1, np.int64)
+        n_new = np.zeros(S, np.int32)
+        collected: Dict[int, list] = {}
+        cur_tok = jnp.zeros((S,), jnp.int32)
+        lengths = jnp.zeros((S,), jnp.int32)
+        done = jnp.ones((S,), bool)  # empty slots are "done"
+        pools = self._pools
+        out: List[CompletedRequest] = []
+
+        while self.sched.waiting or self.sched.running:
+            # -- admission (between jitted segments) --------------------
+            admitted = self.sched.admit()
+            if not admitted and not self.sched.running:
+                raise RuntimeError(
+                    f"{self.sched.waiting} request(s) can never be "
+                    f"scheduled: pool of {self.num_pages} pages is too "
+                    "small for a single request's reservation")
+            for req_id, slot in admitted:
+                pages = self.sched.pages(req_id)
+                self._bt[slot, : len(pages)] = pages
+                self._bt[slot, len(pages):] = pages[-1] if pages else 0
+                ids = prompts[req_id]
+                P = cfg.max_prompt_len
+                row = np.full((1, P), self.pad, np.int32)
+                row[0, : len(ids)] = ids
+                rng, sub = jax.random.split(rng)
+                pools, tok0, lp0, plp0 = self._jit_prefill(
+                    params, pools, jnp.asarray(self._bt[slot:slot + 1]),
+                    jnp.asarray(row), jnp.asarray([len(ids)], jnp.int32),
+                    sub)
+                slot_req[slot] = req_id
+                n_new[slot] = 1
+                collected[req_id] = [(int(tok0[0]), float(lp0[0]),
+                                      float(plp0[0]))]
+                cur_tok = cur_tok.at[slot].set(tok0[0])
+                lengths = lengths.at[slot].set(len(ids))
+                d0 = bool(tok0[0] == self.eos) if self.eos is not None \
+                    else False
+                done = done.at[slot].set(d0)
+
+            # -- decode segment ----------------------------------------
+            if not bool(jnp.all(done)):
+                rng, sub = jax.random.split(rng)
+                active = slot_req >= 0
+                remaining = cfg.max_new_tokens - n_new[active]
+                # Never decode a slot past its page reservation.
+                n = max(1, min(self.segment_len, int(remaining.min())))
+                bt_dev = jnp.asarray(self._bt)
+                pools, toks, lps, plps, cur_tok, lengths, done = \
+                    self._jit_segment(params, pools, bt_dev, cur_tok,
+                                      lengths, done, sub, n_steps=n)
+                toks_h = np.asarray(toks)
+                lps_h = np.asarray(lps)
+                plps_h = np.asarray(plps)
+                for s in range(S):
+                    req_id = slot_req[s]
+                    if req_id < 0:
+                        continue
+                    for t in range(n):
+                        if n_new[s] >= cfg.max_new_tokens:
+                            break
+                        tok = int(toks_h[s, t])
+                        collected[req_id].append(
+                            (tok, float(lps_h[s, t]), float(plps_h[s, t])))
+                        n_new[s] += 1
+                        if self.eos is not None and tok == self.eos:
+                            break
+
+            # -- harvest finished slots --------------------------------
+            done_h = np.asarray(done)
+            for s in range(S):
+                req_id = slot_req[s]
+                if req_id < 0:
+                    continue
+                finished = bool(done_h[s]) or n_new[s] >= cfg.max_new_tokens
+                if finished:
+                    seq = collected.pop(int(req_id))
+                    # trim anything after EOS
+                    toks = [x[0] for x in seq]
+                    if self.eos is not None and self.eos in toks:
+                        cut = toks.index(self.eos) + 1
+                        seq = seq[:cut]
+                    out.append(CompletedRequest(
+                        req_id=int(req_id),
+                        tokens=np.asarray([x[0] for x in seq], np.int32),
+                        logprobs=np.asarray([x[1] for x in seq],
+                                            np.float32)))
+                    self.sched.finish(int(req_id))
+                    slot_req[s] = -1
+                    n_new[s] = 0
+                    self._bt[s, :] = self._scratch  # detach freed pages
+                    done = done.at[s].set(True)
+
+        self._pools = pools
+        return out

@@ -1,0 +1,5 @@
+from orion_tpu.runtime.scheduler import (  # noqa: F401
+    PyScheduler,
+    Scheduler,
+    native_available,
+)

@@ -1,0 +1,159 @@
+// orion-tpu native runtime: paged-KV block allocator + continuous-batching
+// scheduler (SURVEY.md §2 #5 "native layer").
+//
+// TPU-native equivalent of the vLLM C++ scheduler/allocator pair: the
+// device side of paged attention is a Pallas kernel over static-shape
+// pools; THIS code is the host-side control plane that decides which
+// pool pages every sequence owns and which sequences occupy the fixed
+// engine slots between jitted segments.  It is deliberately
+// Python-free so admission decisions cost O(1) C time in the decode
+// loop's host gap.
+//
+// Admission policy: conservative whole-lifetime reservation — a request
+// is admitted only when ceil((prompt_len + max_new) / page_size) pages
+// are free, so a running sequence can never run out of pages and no
+// preemption machinery is needed (matches the static-shape XLA regime).
+//
+// C ABI (extern "C") for ctypes; handles are opaque pointers.
+
+#include <cstdint>
+#include <deque>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+struct Request {
+  int64_t id;
+  int prompt_len;
+  int max_new;
+  int slot = -1;
+  std::vector<int32_t> pages;
+};
+
+class Scheduler {
+ public:
+  Scheduler(int num_pages, int page_size, int max_slots)
+      : page_size_(page_size) {
+    free_pages_.reserve(num_pages);
+    // LIFO free list: recently-freed (cache-warm) pages are reused first.
+    for (int i = num_pages - 1; i >= 0; --i) free_pages_.push_back(i);
+    free_slots_.reserve(max_slots);
+    for (int i = max_slots - 1; i >= 0; --i) free_slots_.push_back(i);
+  }
+
+  void Add(int64_t id, int prompt_len, int max_new) {
+    Request r;
+    r.id = id;
+    r.prompt_len = prompt_len;
+    r.max_new = max_new;
+    waiting_.push_back(std::move(r));
+  }
+
+  // Admit FIFO-order waiting requests while slots + pages suffice.
+  // Writes up to max_out (id, slot) pairs; returns the count.
+  int Admit(int64_t* out_ids, int32_t* out_slots, int max_out) {
+    int n = 0;
+    while (n < max_out && !waiting_.empty() && !free_slots_.empty()) {
+      Request& head = waiting_.front();
+      int need =
+          (head.prompt_len + head.max_new + page_size_ - 1) / page_size_;
+      if (static_cast<int>(free_pages_.size()) < need) break;  // FIFO: no
+                                                               // overtaking
+      Request r = std::move(head);
+      waiting_.pop_front();
+      r.slot = free_slots_.back();
+      free_slots_.pop_back();
+      r.pages.reserve(need);
+      for (int i = 0; i < need; ++i) {
+        r.pages.push_back(free_pages_.back());
+        free_pages_.pop_back();
+      }
+      out_ids[n] = r.id;
+      out_slots[n] = r.slot;
+      running_.emplace(r.id, std::move(r));
+      ++n;
+    }
+    return n;
+  }
+
+  // Copy the request's page table into out (capacity cap); returns the
+  // page count, or -1 if unknown id.
+  int Pages(int64_t id, int32_t* out, int cap) const {
+    auto it = running_.find(id);
+    if (it == running_.end()) return -1;
+    const auto& p = it->second.pages;
+    int n = static_cast<int>(p.size());
+    for (int i = 0; i < n && i < cap; ++i) out[i] = p[i];
+    return n;
+  }
+
+  int Slot(int64_t id) const {
+    auto it = running_.find(id);
+    return it == running_.end() ? -1 : it->second.slot;
+  }
+
+  // Retire a finished request, freeing its slot and pages.
+  // Returns pages freed, or -1 if unknown id.
+  int Finish(int64_t id) {
+    auto it = running_.find(id);
+    if (it == running_.end()) return -1;
+    int freed = static_cast<int>(it->second.pages.size());
+    for (int32_t p : it->second.pages) free_pages_.push_back(p);
+    free_slots_.push_back(it->second.slot);
+    running_.erase(it);
+    return freed;
+  }
+
+  int FreePages() const { return static_cast<int>(free_pages_.size()); }
+  int Waiting() const { return static_cast<int>(waiting_.size()); }
+  int Running() const { return static_cast<int>(running_.size()); }
+
+ private:
+  int page_size_;
+  std::vector<int32_t> free_pages_;
+  std::vector<int32_t> free_slots_;
+  std::deque<Request> waiting_;
+  std::unordered_map<int64_t, Request> running_;
+};
+
+}  // namespace
+
+extern "C" {
+
+void* osch_create(int num_pages, int page_size, int max_slots) {
+  if (num_pages <= 0 || page_size <= 0 || max_slots <= 0) return nullptr;
+  return new Scheduler(num_pages, page_size, max_slots);
+}
+
+void osch_destroy(void* h) { delete static_cast<Scheduler*>(h); }
+
+void osch_add(void* h, int64_t id, int prompt_len, int max_new) {
+  static_cast<Scheduler*>(h)->Add(id, prompt_len, max_new);
+}
+
+int osch_admit(void* h, int64_t* out_ids, int32_t* out_slots, int max_out) {
+  return static_cast<Scheduler*>(h)->Admit(out_ids, out_slots, max_out);
+}
+
+int osch_pages(void* h, int64_t id, int32_t* out, int cap) {
+  return static_cast<Scheduler*>(h)->Pages(id, out, cap);
+}
+
+int osch_slot(void* h, int64_t id) {
+  return static_cast<Scheduler*>(h)->Slot(id);
+}
+
+int osch_finish(void* h, int64_t id) {
+  return static_cast<Scheduler*>(h)->Finish(id);
+}
+
+int osch_free_pages(void* h) {
+  return static_cast<Scheduler*>(h)->FreePages();
+}
+
+int osch_waiting(void* h) { return static_cast<Scheduler*>(h)->Waiting(); }
+
+int osch_running(void* h) { return static_cast<Scheduler*>(h)->Running(); }
+
+}  // extern "C"

@@ -1,0 +1,202 @@
+"""Host-side runtime control plane: ctypes bindings for the native
+continuous-batching scheduler + a pure-Python mirror (SURVEY.md §2 #5).
+
+The C++ library (native/orion_runtime.cc) is compiled on first use with
+g++ into ``native/_build/`` and loaded via ctypes — no pybind11
+dependency.  ``Scheduler`` prefers the native implementation and falls
+back to :class:`PyScheduler` when no toolchain is available; both obey
+the identical contract (cross-checked in tests/test_runtime_native.py).
+
+Contract: conservative whole-lifetime page reservation at admission
+(never preempts), FIFO order without overtaking, LIFO page reuse.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import List, Optional, Tuple
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "native", "orion_runtime.cc")
+_BUILD_DIR = os.path.join(_HERE, "native", "_build")
+_SO = os.path.join(_BUILD_DIR, "liborion_runtime.so")
+
+_lib = None
+_lib_lock = threading.Lock()
+
+
+def _compile() -> Optional[str]:
+    os.makedirs(_BUILD_DIR, exist_ok=True)
+    if os.path.exists(_SO) and \
+            os.path.getmtime(_SO) >= os.path.getmtime(_SRC):
+        return _SO
+    cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", _SRC, "-o", _SO]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        return _SO
+    except (OSError, subprocess.SubprocessError):
+        return None
+
+
+def _load():
+    global _lib
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        so = _compile()
+        if so is None:
+            return None
+        lib = ctypes.CDLL(so)
+        lib.osch_create.restype = ctypes.c_void_p
+        lib.osch_create.argtypes = [ctypes.c_int, ctypes.c_int, ctypes.c_int]
+        lib.osch_destroy.argtypes = [ctypes.c_void_p]
+        lib.osch_add.argtypes = [ctypes.c_void_p, ctypes.c_int64,
+                                 ctypes.c_int, ctypes.c_int]
+        lib.osch_admit.restype = ctypes.c_int
+        lib.osch_admit.argtypes = [ctypes.c_void_p,
+                                   ctypes.POINTER(ctypes.c_int64),
+                                   ctypes.POINTER(ctypes.c_int32),
+                                   ctypes.c_int]
+        lib.osch_pages.restype = ctypes.c_int
+        lib.osch_pages.argtypes = [ctypes.c_void_p, ctypes.c_int64,
+                                   ctypes.POINTER(ctypes.c_int32),
+                                   ctypes.c_int]
+        lib.osch_slot.restype = ctypes.c_int
+        lib.osch_slot.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+        lib.osch_finish.restype = ctypes.c_int
+        lib.osch_finish.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+        for name in ("osch_free_pages", "osch_waiting", "osch_running"):
+            fn = getattr(lib, name)
+            fn.restype = ctypes.c_int
+            fn.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return _lib
+
+
+def native_available() -> bool:
+    return _load() is not None
+
+
+class _NativeScheduler:
+    def __init__(self, num_pages: int, page_size: int, max_slots: int):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError("native runtime unavailable (no g++?)")
+        self._lib = lib
+        self._h = lib.osch_create(num_pages, page_size, max_slots)
+        if not self._h:
+            raise ValueError("bad scheduler parameters")
+        self.max_slots = max_slots
+
+    def __del__(self):
+        if getattr(self, "_h", None):
+            self._lib.osch_destroy(self._h)
+            self._h = None
+
+    def add(self, req_id: int, prompt_len: int, max_new: int) -> None:
+        self._lib.osch_add(self._h, req_id, prompt_len, max_new)
+
+    def admit(self) -> List[Tuple[int, int]]:
+        ids = (ctypes.c_int64 * self.max_slots)()
+        slots = (ctypes.c_int32 * self.max_slots)()
+        n = self._lib.osch_admit(self._h, ids, slots, self.max_slots)
+        return [(int(ids[i]), int(slots[i])) for i in range(n)]
+
+    def pages(self, req_id: int) -> List[int]:
+        cap = 1 << 16
+        out = (ctypes.c_int32 * cap)()
+        n = self._lib.osch_pages(self._h, req_id, out, cap)
+        if n < 0:
+            raise KeyError(req_id)
+        return [int(out[i]) for i in range(n)]
+
+    def slot(self, req_id: int) -> int:
+        s = self._lib.osch_slot(self._h, req_id)
+        if s < 0:
+            raise KeyError(req_id)
+        return s
+
+    def finish(self, req_id: int) -> int:
+        n = self._lib.osch_finish(self._h, req_id)
+        if n < 0:
+            raise KeyError(req_id)
+        return n
+
+    @property
+    def free_pages(self) -> int:
+        return self._lib.osch_free_pages(self._h)
+
+    @property
+    def waiting(self) -> int:
+        return self._lib.osch_waiting(self._h)
+
+    @property
+    def running(self) -> int:
+        return self._lib.osch_running(self._h)
+
+
+class PyScheduler:
+    """Pure-Python mirror of the native scheduler (same contract)."""
+
+    def __init__(self, num_pages: int, page_size: int, max_slots: int):
+        if num_pages <= 0 or page_size <= 0 or max_slots <= 0:
+            raise ValueError("bad scheduler parameters")
+        self._ps = page_size
+        # Reversed so .pop() hands out 0,1,2,... exactly like the native
+        # LIFO free list (cross-checked in tests).
+        self._free_pages = list(range(num_pages - 1, -1, -1))
+        self._free_slots = list(range(max_slots - 1, -1, -1))
+        self._waiting: list = []
+        self._running: dict = {}
+        self.max_slots = max_slots
+
+    def add(self, req_id: int, prompt_len: int, max_new: int) -> None:
+        self._waiting.append((req_id, prompt_len, max_new))
+
+    def admit(self) -> List[Tuple[int, int]]:
+        out = []
+        while self._waiting and self._free_slots:
+            req_id, plen, mnew = self._waiting[0]
+            need = -(-(plen + mnew) // self._ps)
+            if len(self._free_pages) < need:
+                break
+            self._waiting.pop(0)
+            slot = self._free_slots.pop()
+            pages = [self._free_pages.pop() for _ in range(need)]
+            self._running[req_id] = (slot, pages)
+            out.append((req_id, slot))
+        return out
+
+    def pages(self, req_id: int) -> List[int]:
+        return list(self._running[req_id][1])
+
+    def slot(self, req_id: int) -> int:
+        return self._running[req_id][0]
+
+    def finish(self, req_id: int) -> int:
+        slot, pages = self._running.pop(req_id)
+        self._free_pages.extend(pages)
+        self._free_slots.append(slot)
+        return len(pages)
+
+    @property
+    def free_pages(self) -> int:
+        return len(self._free_pages)
+
+    @property
+    def waiting(self) -> int:
+        return len(self._waiting)
+
+    @property
+    def running(self) -> int:
+        return len(self._running)
+
+
+def Scheduler(num_pages: int, page_size: int, max_slots: int):
+    """Native scheduler when the toolchain allows, PyScheduler otherwise."""
+    if native_available():
+        return _NativeScheduler(num_pages, page_size, max_slots)
+    return PyScheduler(num_pages, page_size, max_slots)

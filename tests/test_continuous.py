@@ -1,0 +1,80 @@
+"""Continuous-batching engine tests (SURVEY.md §2 #5, §3c): more
+requests than slots, ragged prompts, EOS retirement, page recycling —
+each request's output must equal a solo run of the simple engine."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from orion_tpu.config import ModelConfig, RolloutConfig
+from orion_tpu.models import Transformer, init_params
+from orion_tpu.rollout import RolloutEngine
+from orion_tpu.rollout.continuous import ContinuousBatchingEngine
+
+
+def _setup(eos=None, max_new=10, slots=2):
+    cfg = ModelConfig.tiny(dtype="float32")
+    model = Transformer(cfg)
+    params = init_params(model, jax.random.key(0), cfg)
+    rcfg = RolloutConfig(max_prompt_len=12, max_new_tokens=max_new,
+                         temperature=0.0, page_size=4, max_batch_size=slots)
+    eng = ContinuousBatchingEngine(model, cfg, rcfg, eos_token_id=eos,
+                                   segment_len=4)
+    solo = RolloutEngine(model, cfg,
+                         RolloutConfig(max_new_tokens=max_new,
+                                       temperature=0.0, paged=True,
+                                       page_size=4),
+                         eos_token_id=eos)
+    solo.load_weights(params)
+    return cfg, model, params, eng, solo
+
+
+def _solo_completion(solo, ids, max_new):
+    r = solo.generate(jnp.asarray(ids[None, :]),
+                      jnp.asarray([len(ids)], np.int32), jax.random.key(0))
+    n = int(r.completion_lens[0])
+    return np.asarray(r.completions[0, :n])
+
+
+def test_continuous_matches_solo_greedy():
+    cfg, model, params, eng, solo = _setup()
+    rng = np.random.RandomState(0)
+    reqs = [(i, rng.randint(1, cfg.vocab_size, rng.randint(3, 12)))
+            for i in range(7)]  # 7 requests, 2 slots
+    out = eng.generate(reqs, jax.random.key(1), params)
+    assert sorted(r.req_id for r in out) == list(range(7))
+    for r in out:
+        ids = dict(reqs)[r.req_id]
+        expect = _solo_completion(solo, np.asarray(ids, np.int32), 10)
+        np.testing.assert_array_equal(r.tokens, expect,
+                                      err_msg=f"req {r.req_id}")
+
+
+def test_continuous_eos_and_recycling():
+    # eos id chosen so greedy decode hits it sometimes on a tiny model
+    cfg, model, params, eng, solo = _setup(eos=5, max_new=12, slots=2)
+    rng = np.random.RandomState(3)
+    reqs = [(i, rng.randint(1, cfg.vocab_size, rng.randint(2, 12)))
+            for i in range(6)]
+    out = eng.generate(reqs, jax.random.key(2), params)
+    assert sorted(r.req_id for r in out) == list(range(6))
+    hit_eos = 0
+    for r in out:
+        ids = dict(reqs)[r.req_id]
+        expect = _solo_completion(solo, np.asarray(ids, np.int32), 12)
+        np.testing.assert_array_equal(r.tokens, expect,
+                                      err_msg=f"req {r.req_id}")
+        if 5 in r.tokens:
+            hit_eos += 1
+            assert r.tokens[-1] == 5  # trimmed at EOS
+    # All pages recycled at the end.
+    assert eng.sched.free_pages == eng.num_pages
+    assert eng.sched.running == 0 and eng.sched.waiting == 0
+
+
+def test_continuous_rejects_oversized_prompt():
+    cfg, model, params, eng, _ = _setup()
+    import pytest
+
+    with pytest.raises(ValueError, match="longer than"):
+        eng.generate([(0, np.ones(13, np.int32))], jax.random.key(0), params)

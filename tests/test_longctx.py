@@ -1,0 +1,195 @@
+"""Sequence/context parallelism tests on the 8-fake-device mesh
+(SURVEY.md §4-5): Ulysses and ring attention vs dense reference, values
+and gradients, contiguous and zigzag layouts."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+from jax import shard_map
+
+from orion_tpu.config import MeshConfig
+from orion_tpu.ops.attention import reference_attention, repeat_kv
+from orion_tpu.parallel.longctx import (ring_attention, ulysses_attention,
+                                        zigzag_inverse, zigzag_order)
+from orion_tpu.parallel.mesh import make_mesh
+
+S = 4  # seq-parallel degree
+
+
+def _mesh():
+    return make_mesh(MeshConfig(data=1, fsdp=2, seq=S, tensor=1))
+
+
+def _inputs(B=2, L=32, H=8, Hkv=4, D=16, seed=0):
+    ks = jax.random.split(jax.random.key(seed), 3)
+    q = jax.random.normal(ks[0], (B, L, H, D), jnp.float32)
+    k = jax.random.normal(ks[1], (B, L, Hkv, D), jnp.float32)
+    v = jax.random.normal(ks[2], (B, L, Hkv, D), jnp.float32)
+    pos = jnp.broadcast_to(jnp.arange(L, dtype=jnp.int32), (B, L))
+    return q, k, v, pos
+
+
+def _dense(q, k, v, pos, scale):
+    n_rep = q.shape[2] // k.shape[2]
+    mask = jnp.arange(k.shape[1])[None, None, :] <= pos[:, :, None]
+    return reference_attention(q, repeat_kv(k, n_rep), repeat_kv(v, n_rep),
+                               mask, scale)
+
+
+def _sharded(fn, mesh, n_arrays=4):
+    specs = (P(None, "seq"),) if n_arrays == 1 else \
+        tuple(P(None, "seq") for _ in range(n_arrays))
+    return shard_map(fn, mesh=mesh, in_specs=specs,
+                     out_specs=P(None, "seq"), check_vma=False)
+
+
+def test_ulysses_matches_dense():
+    mesh = _mesh()
+    q, k, v, pos = _inputs()
+    scale = 0.25
+
+    fn = _sharded(
+        functools.partial(ulysses_attention, scale=scale), mesh)
+    with mesh:
+        out = jax.jit(fn)(q, k, v, pos)
+    ref = _dense(q, k, v, pos, scale)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_ring_matches_dense_contiguous():
+    mesh = _mesh()
+    q, k, v, pos = _inputs(seed=1)
+    scale = 0.25
+
+    def local(q, k, v, qp, kp):
+        return ring_attention(q, k, v, qp, kp, scale)
+
+    fn = shard_map(local, mesh=mesh,
+                   in_specs=tuple([P(None, "seq")] * 5),
+                   out_specs=P(None, "seq"), check_vma=False)
+    with mesh:
+        out = jax.jit(fn)(q, k, v, pos, pos)
+    ref = _dense(q, k, v, pos, scale)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_ring_matches_dense_zigzag():
+    """Zigzag layout: tokens reordered for causal balance; result maps
+    back to the dense reference through the inverse permutation."""
+    mesh = _mesh()
+    B, L = 2, 32
+    q, k, v, pos = _inputs(B=B, L=L, seed=2)
+    scale = 0.25
+    order = zigzag_order(L, S)
+    inv = zigzag_inverse(L, S)
+
+    qz, kz, vz = q[:, order], k[:, order], v[:, order]
+    posz = pos[:, order]
+
+    def local(q, k, v, qp, kp):
+        return ring_attention(q, k, v, qp, kp, scale)
+
+    fn = shard_map(local, mesh=mesh,
+                   in_specs=tuple([P(None, "seq")] * 5),
+                   out_specs=P(None, "seq"), check_vma=False)
+    with mesh:
+        outz = jax.jit(fn)(qz, kz, vz, posz, posz)
+    out = np.asarray(outz)[:, inv]
+    ref = _dense(q, k, v, pos, scale)
+    np.testing.assert_allclose(out, np.asarray(ref), rtol=2e-5, atol=2e-5)
+
+
+def test_ring_gradients_match_dense():
+    mesh = _mesh()
+    q, k, v, pos = _inputs(B=1, L=16, H=4, Hkv=2, D=8, seed=3)
+    scale = 1.0 / 8 ** 0.5
+
+    def local(q, k, v, qp, kp):
+        return ring_attention(q, k, v, qp, kp, scale)
+
+    fn = shard_map(local, mesh=mesh,
+                   in_specs=tuple([P(None, "seq")] * 5),
+                   out_specs=P(None, "seq"), check_vma=False)
+
+    def loss_ring(q, k, v):
+        o = fn(q, k, v, pos, pos)
+        return jnp.sum(o * jnp.cos(o))
+
+    def loss_dense(q, k, v):
+        o = _dense(q, k, v, pos, scale)
+        return jnp.sum(o * jnp.cos(o))
+
+    with mesh:
+        g_ring = jax.jit(jax.grad(loss_ring, argnums=(0, 1, 2)))(q, k, v)
+    g_ref = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(g_ring, g_ref, "qkv"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-4, err_msg=name)
+
+
+def test_ulysses_gradients_match_dense():
+    mesh = _mesh()
+    q, k, v, pos = _inputs(B=1, L=16, H=4, Hkv=4, D=8, seed=4)
+    scale = 0.3
+
+    fn = _sharded(functools.partial(ulysses_attention, scale=scale), mesh)
+
+    def loss_u(q, k, v):
+        return jnp.sum(jnp.sin(fn(q, k, v, pos)))
+
+    def loss_d(q, k, v):
+        return jnp.sum(jnp.sin(_dense(q, k, v, pos, scale)))
+
+    with mesh:
+        g_u = jax.jit(jax.grad(loss_u, argnums=(0, 1, 2)))(q, k, v)
+    g_d = jax.grad(loss_d, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_u, g_d):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-4)
+
+
+def test_model_forward_seq_parallel_ring():
+    """Whole Transformer under shard_map with sequence-sharded
+    activations and attention_impl='ring' equals the dense model — the
+    end-to-end SP training forward (SURVEY.md §5 long-context)."""
+    from orion_tpu.config import ModelConfig
+    from orion_tpu.models import Transformer, init_params
+
+    mesh = _mesh()
+    cfg_d = ModelConfig.tiny(dtype="float32")
+    cfg_r = ModelConfig.tiny(dtype="float32", attention_impl="ring")
+    model_d, model_r = Transformer(cfg_d), Transformer(cfg_r)
+    params = init_params(model_d, jax.random.key(0), cfg_d)
+
+    B, L = 2, 32
+    ids = jax.random.randint(jax.random.key(1), (B, L), 0, cfg_d.vocab_size)
+    pos = jnp.broadcast_to(jnp.arange(L, dtype=jnp.int32), (B, L))
+
+    def fwd(params, ids, pos):
+        logits, _ = model_r.apply({"params": params}, ids, pos)
+        return logits
+
+    fn = shard_map(fwd, mesh=mesh,
+                   in_specs=(P(), P(None, "seq"), P(None, "seq")),
+                   out_specs=P(None, "seq"), check_vma=False)
+    with mesh:
+        logits_sp = jax.jit(fn)(params, ids, pos)
+    logits_d, _ = model_d.apply({"params": params}, ids, pos)
+    np.testing.assert_allclose(np.asarray(logits_sp), np.asarray(logits_d),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_zigzag_roundtrip_and_balance():
+    L = 64
+    order = zigzag_order(L, S)
+    inv = zigzag_inverse(L, S)
+    np.testing.assert_array_equal(order[inv], np.arange(L))
+    # Causal balance: every device's token-position sum is equal.
+    per_dev = order.reshape(S, L // S)
+    sums = per_dev.sum(axis=1)
+    assert np.all(sums == sums[0])
