@@ -175,7 +175,12 @@ class RolloutConfig:
     temperature: float = 1.0
     top_k: int = 0  # 0 => disabled
     top_p: float = 1.0  # 1.0 => disabled
-    # Paged KV cache: capacity in pages; page_size tokens per page.
+    # Paged KV cache for RolloutEngine: capacity in pages; page_size
+    # tokens per page.  Default False: for fixed-batch generate the
+    # dense cache is ~2.6x faster on-chip (measured v5e, B=32/L=256 —
+    # paging buys slot reuse and long-context memory, not per-step
+    # speed); the ContinuousBatchingEngine always uses the paged pool,
+    # which is where those wins live.
     paged: bool = False
     page_size: int = 64
     num_pages: int = 0  # 0 => derived from batch * max_len

@@ -56,6 +56,32 @@ def convert_hf_state_dict(sd: Mapping[str, Any], cfg: ModelConfig,
         raise ValueError(cfg.arch)
     if not include_lm_head:
         p.pop("lm_head", None)
+    if cfg.scan_layers:
+        p = stack_layer_params(p, cfg.num_layers)
+    return p
+
+
+def stack_layer_params(p: dict, num_layers: int) -> dict:
+    """layers_0..layers_{N-1} sub-trees → one "layers" tree with a
+    leading [N] axis (the scan_layers param layout).  Returns a new
+    top-level dict; the input is not mutated."""
+    import jax
+
+    p = dict(p)
+    layers = [p.pop(f"layers_{i}") for i in range(num_layers)]
+    p["layers"] = jax.tree.map(lambda *xs: np.stack(xs), *layers)
+    return p
+
+
+def unstack_layer_params(p: dict, num_layers: int) -> dict:
+    """Inverse of :func:`stack_layer_params` (HF export path).  Returns
+    a new top-level dict; the input is not mutated."""
+    import jax
+
+    p = dict(p)
+    stacked = p.pop("layers")
+    for i in range(num_layers):
+        p[f"layers_{i}"] = jax.tree.map(lambda x: np.asarray(x[i]), stacked)
     return p
 
 

@@ -22,9 +22,12 @@ import jax.numpy as jnp
 
 def init_paged_cache(num_layers: int, batch: int, max_len: int,
                      num_kv_heads: int, head_dim: int, page_size: int,
-                     num_pages: int = 0, dtype=jnp.bfloat16) -> List[dict]:
+                     num_pages: int = 0, dtype=jnp.bfloat16,
+                     stacked: bool = False):
     """Per-layer {"k_pages", "v_pages", "block_tables"} with a contiguous
-    block-table assignment.  max_len is rounded up to whole pages."""
+    block-table assignment.  max_len is rounded up to whole pages.
+    ``stacked=True`` (scan_layers models) returns one pytree with a
+    leading [num_layers] axis instead of a per-layer list."""
     pages_per_seq = -(-max_len // page_size)
     if num_pages <= 0:
         num_pages = batch * pages_per_seq
@@ -34,6 +37,12 @@ def init_paged_cache(num_layers: int, batch: int, max_len: int,
     bt = (jnp.arange(batch, dtype=jnp.int32)[:, None] * pages_per_seq
           + jnp.arange(pages_per_seq, dtype=jnp.int32)[None, :])
     shape = (num_pages, num_kv_heads, page_size, head_dim)
+    if stacked:
+        stk = (num_layers,) + shape
+        return {"k_pages": jnp.zeros(stk, dtype),
+                "v_pages": jnp.zeros(stk, dtype),
+                "block_tables": jnp.broadcast_to(
+                    bt, (num_layers,) + bt.shape)}
     return [{"k_pages": jnp.zeros(shape, dtype),
              "v_pages": jnp.zeros(shape, dtype),
              "block_tables": bt}

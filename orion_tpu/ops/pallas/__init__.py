@@ -14,6 +14,25 @@ import jax
 NEG_INF = -1e30
 
 
+def target_platform() -> str:
+    """Platform the current trace will execute on.
+
+    An active ``with mesh:`` context wins over the default backend —
+    a CPU fake-device mesh on a TPU box (the SURVEY.md §4 test harness
+    and the driver's dryrun fallback) must compile kernels for CPU, and
+    vice versa a TPU mesh on a box whose default backend is CPU.
+    """
+    try:
+        from jax._src import mesh as mesh_lib
+
+        m = mesh_lib.thread_resources.env.physical_mesh
+        if m is not None and not m.empty:
+            return m.devices.flat[0].platform
+    except Exception:
+        pass
+    return jax.default_backend()
+
+
 def interpret_mode() -> bool:
     """Run kernels interpreted off-TPU (CPU test harness)."""
-    return jax.default_backend() != "tpu"
+    return target_platform() != "tpu"

@@ -18,10 +18,11 @@ process:
   from the train-mesh sharding to the rollout-mesh sharding — XLA lowers
   the reshard to ICI transfers; there is no user-space comm code.
 
-Off-policy correctness: trainers consume the engine's raw behavior
-logprobs as ``old_logprobs`` (``cfg.async_mode=True`` — see
-``BaseTrainer.behavior_logprobs``) so PPO-family clipped ratios carry
-the staleness correction.
+Off-policy correctness: trainers consume the engine's *sampling-
+distribution* logprobs (temperature/top-k/top-p applied — the
+distribution the tokens were actually drawn from) as ``old_logprobs``
+(``cfg.async_mode=True`` — see ``BaseTrainer.behavior_logprobs``) so
+PPO-family clipped ratios carry the staleness correction unbiased.
 """
 
 from __future__ import annotations
@@ -56,6 +57,7 @@ class _Item:
     result_host: dict        # GenerationResult fields as numpy
     scores: np.ndarray       # [B]
     version: int             # weight version used for generation
+    data_state: Optional[dict] = None  # prompt-iterator cursor snapshot
 
 
 class AsyncOrchestrator:
@@ -144,6 +146,12 @@ class AsyncOrchestrator:
                 if self._stop.is_set():
                     return
                 batch = next(prompt_iter)
+                # Iterator-cursor snapshot taken HERE, on the only
+                # thread that advances the iterator — the learner saves
+                # this copy, never calling state() concurrently with
+                # __next__ (torn epoch/cursor reads at epoch rollover).
+                data_state = prompt_iter.state() \
+                    if hasattr(prompt_iter, "state") else None
                 ids, lens, meta = self.trainer.prepare_prompts(batch)
                 with self._weights_lock:
                     params = self._rollout_params
@@ -158,7 +166,7 @@ class AsyncOrchestrator:
                 result_host = {
                     f.name: np.asarray(getattr(result, f.name))
                     for f in dataclasses.fields(result)}
-                item = _Item(result_host, scores, version)
+                item = _Item(result_host, scores, version, data_state)
                 while not self._stop.is_set():
                     try:
                         self._queue.put(item, timeout=0.1)
@@ -227,11 +235,22 @@ class AsyncOrchestrator:
                     "samples_per_sec": n_samples / (t2 - t0),
                 })
                 trainer.metrics_history.append(stats)
+                if trainer.writer is not None:
+                    trainer.writer.write(trainer.global_iter, stats)
                 if trainer.cfg.log_every and it % trainer.cfg.log_every == 0:
                     trainer.log(stats)
+                if trainer.ckpt is not None and \
+                        trainer.global_iter % trainer.cfg.checkpoint_every == 0:
+                    # The saved cursor is the rollout thread's snapshot
+                    # for the batch being trained — it lags the live
+                    # iterator by at most `staleness` batches, so a
+                    # resume replays only freshly-generated experience.
+                    trainer.save_checkpoint(data_state=item.data_state)
         finally:
             self._stop.set()
             worker.join(timeout=30.0)
+        if trainer.ckpt is not None:
+            trainer.ckpt.wait()
         if self._rollout_error is not None:
             raise RuntimeError("rollout worker died") from self._rollout_error
         return trainer.metrics_history

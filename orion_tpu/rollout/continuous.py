@@ -67,9 +67,16 @@ class ContinuousBatchingEngine:
         shape = (self.num_pages + 1, model_cfg.num_kv_heads, ps,
                  model_cfg.head_dim)
         dt = jnp.dtype(model_cfg.dtype)
-        self._pools = [{"k_pages": jnp.zeros(shape, dt),
-                        "v_pages": jnp.zeros(shape, dt)}
-                       for _ in range(model_cfg.num_layers)]
+        if model_cfg.scan_layers:
+            # Stacked [num_layers, ...] pools matching the scan-path
+            # Transformer's cache pytree layout.
+            stk = (model_cfg.num_layers,) + shape
+            self._pools = {"k_pages": jnp.zeros(stk, dt),
+                           "v_pages": jnp.zeros(stk, dt)}
+        else:
+            self._pools = [{"k_pages": jnp.zeros(shape, dt),
+                            "v_pages": jnp.zeros(shape, dt)}
+                           for _ in range(model_cfg.num_layers)]
         self._bt = np.full((self.slots, self.pages_per_seq), self._scratch,
                            np.int32)
 
@@ -79,8 +86,21 @@ class ContinuousBatchingEngine:
 
     # -- jitted programs ------------------------------------------------
     def _cache(self, pools, bt):
+        if self.mc.scan_layers:
+            return {"k_pages": pools["k_pages"],
+                    "v_pages": pools["v_pages"],
+                    "block_tables": jnp.broadcast_to(
+                        bt, (self.mc.num_layers,) + bt.shape)}
         return [{"k_pages": p["k_pages"], "v_pages": p["v_pages"],
                  "block_tables": bt} for p in pools]
+
+    def _strip(self, cache):
+        """Drop block tables from the post-apply cache → pool state."""
+        if self.mc.scan_layers:
+            return {"k_pages": cache["k_pages"],
+                    "v_pages": cache["v_pages"]}
+        return [{"k_pages": c["k_pages"], "v_pages": c["v_pages"]}
+                for c in cache]
 
     def _prefill_fn(self, params, pools, bt_row, prompt_ids, prompt_len,
                     rng):
@@ -99,9 +119,7 @@ class ContinuousBatchingEngine:
         tok0, lp0, plp0 = sample_tokens(
             rng, last, temperature=self.cfg.temperature,
             top_k=self.cfg.top_k, top_p=self.cfg.top_p)
-        pools = [{"k_pages": c["k_pages"], "v_pages": c["v_pages"]}
-                 for c in cache]
-        return pools, tok0, lp0, plp0
+        return self._strip(cache), tok0, lp0, plp0
 
     def _segment_fn(self, params, pools, bt, cur_tok, lengths, done, rng,
                     n_steps: int):
@@ -135,9 +153,8 @@ class ContinuousBatchingEngine:
             if self.eos is not None:
                 done = done | (nxt == self.eos)
             lengths = lengths + 1  # the written position always advances
-            pools = [{"k_pages": c["k_pages"], "v_pages": c["v_pages"]}
-                     for c in cache]
-            return pools, nxt, lengths, done, rng, toks, lps, plps
+            return (self._strip(cache), nxt, lengths, done, rng, toks,
+                    lps, plps)
 
         toks = jnp.full((S, n_steps), pad, jnp.int32)
         lps = jnp.zeros((S, n_steps), jnp.float32)
@@ -187,7 +204,12 @@ class ContinuousBatchingEngine:
             for req_id, slot in admitted:
                 pages = self.sched.pages(req_id)
                 self._bt[slot, : len(pages)] = pages
-                self._bt[slot, len(pages):] = pages[-1] if pages else 0
+                # Unreserved tail → scratch page: prefill writes KV for
+                # every padded prompt position, and a short-reservation
+                # request (prompt_len + max_new < max_prompt_len) would
+                # otherwise wrap pad-position writes onto its *last real
+                # page*, clobbering prompt KV (ADVICE r1 high).
+                self._bt[slot, len(pages):] = self._scratch
                 ids = prompts[req_id]
                 P = cfg.max_prompt_len
                 row = np.full((1, P), self.pad, np.int32)

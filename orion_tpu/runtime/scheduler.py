@@ -28,14 +28,32 @@ _lib = None
 _lib_lock = threading.Lock()
 
 
+def _src_hash() -> str:
+    import hashlib
+
+    with open(_SRC, "rb") as f:
+        return hashlib.sha256(f.read()).hexdigest()
+
+
 def _compile() -> Optional[str]:
+    """Build the .so iff missing or the source hash changed.
+
+    Freshness is content-hashed, not mtime-based: checkout mtimes are
+    arbitrary after a clone, and the build dir is gitignored (no binary
+    is ever committed — ADVICE r1).
+    """
     os.makedirs(_BUILD_DIR, exist_ok=True)
-    if os.path.exists(_SO) and \
-            os.path.getmtime(_SO) >= os.path.getmtime(_SRC):
-        return _SO
+    hash_file = _SO + ".sha256"
+    want = _src_hash()
+    if os.path.exists(_SO) and os.path.exists(hash_file):
+        with open(hash_file) as f:
+            if f.read().strip() == want:
+                return _SO
     cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", _SRC, "-o", _SO]
     try:
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        with open(hash_file, "w") as f:
+            f.write(want)
         return _SO
     except (OSError, subprocess.SubprocessError):
         return None
@@ -46,34 +64,53 @@ def _load():
     with _lib_lock:
         if _lib is not None:
             return _lib
-        so = _compile()
-        if so is None:
-            return None
-        lib = ctypes.CDLL(so)
-        lib.osch_create.restype = ctypes.c_void_p
-        lib.osch_create.argtypes = [ctypes.c_int, ctypes.c_int, ctypes.c_int]
-        lib.osch_destroy.argtypes = [ctypes.c_void_p]
-        lib.osch_add.argtypes = [ctypes.c_void_p, ctypes.c_int64,
-                                 ctypes.c_int, ctypes.c_int]
-        lib.osch_admit.restype = ctypes.c_int
-        lib.osch_admit.argtypes = [ctypes.c_void_p,
-                                   ctypes.POINTER(ctypes.c_int64),
-                                   ctypes.POINTER(ctypes.c_int32),
-                                   ctypes.c_int]
-        lib.osch_pages.restype = ctypes.c_int
-        lib.osch_pages.argtypes = [ctypes.c_void_p, ctypes.c_int64,
-                                   ctypes.POINTER(ctypes.c_int32),
-                                   ctypes.c_int]
-        lib.osch_slot.restype = ctypes.c_int
-        lib.osch_slot.argtypes = [ctypes.c_void_p, ctypes.c_int64]
-        lib.osch_finish.restype = ctypes.c_int
-        lib.osch_finish.argtypes = [ctypes.c_void_p, ctypes.c_int64]
-        for name in ("osch_free_pages", "osch_waiting", "osch_running"):
-            fn = getattr(lib, name)
-            fn.restype = ctypes.c_int
-            fn.argtypes = [ctypes.c_void_p]
+        try:
+            lib = _bind(_compile())
+        except OSError:
+            # Incompatible/corrupt binary (e.g. copied from another
+            # arch) whose content hash still matches: self-heal by
+            # discarding it and rebuilding once; fall back to
+            # PyScheduler only if the rebuild also fails to load.
+            for p in (_SO, _SO + ".sha256"):
+                try:
+                    os.remove(p)
+                except OSError:
+                    pass
+            try:
+                lib = _bind(_compile())
+            except OSError:
+                return None
         _lib = lib
         return _lib
+
+
+def _bind(so: Optional[str]):
+    if so is None:
+        return None
+    lib = ctypes.CDLL(so)
+    lib.osch_create.restype = ctypes.c_void_p
+    lib.osch_create.argtypes = [ctypes.c_int, ctypes.c_int, ctypes.c_int]
+    lib.osch_destroy.argtypes = [ctypes.c_void_p]
+    lib.osch_add.argtypes = [ctypes.c_void_p, ctypes.c_int64,
+                             ctypes.c_int, ctypes.c_int]
+    lib.osch_admit.restype = ctypes.c_int
+    lib.osch_admit.argtypes = [ctypes.c_void_p,
+                               ctypes.POINTER(ctypes.c_int64),
+                               ctypes.POINTER(ctypes.c_int32),
+                               ctypes.c_int]
+    lib.osch_pages.restype = ctypes.c_int
+    lib.osch_pages.argtypes = [ctypes.c_void_p, ctypes.c_int64,
+                               ctypes.POINTER(ctypes.c_int32),
+                               ctypes.c_int]
+    lib.osch_slot.restype = ctypes.c_int
+    lib.osch_slot.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+    lib.osch_finish.restype = ctypes.c_int
+    lib.osch_finish.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+    for name in ("osch_free_pages", "osch_waiting", "osch_running"):
+        fn = getattr(lib, name)
+        fn.restype = ctypes.c_int
+        fn.argtypes = [ctypes.c_void_p]
+    return lib
 
 
 def native_available() -> bool:

@@ -192,13 +192,16 @@ class BaseTrainer:
 
         Sync mode: recomputed under the *current* training graph, so the
         clipped ratio is exactly 1 on the first epoch (no sampler/
-        trainer drift in the objective).  Async mode: the engine's raw
-        policy logprobs — the *stale* behavior policy that actually
-        produced the tokens — so the ratio carries the one-step
-        off-policy correction (SURVEY.md §3b).
+        trainer drift in the objective).  Async mode: the engine's
+        *sampling-distribution* logprobs — temperature/top-k/top-p
+        applied — because that tempered/truncated distribution is the
+        behavior policy the tokens were actually drawn from; using the
+        raw policy logprob would bias the off-policy correction whenever
+        temperature != 1 or truncation is active (SURVEY.md §3b).
+        ``result.policy_logprobs`` (raw) stays available for diagnostics.
         """
         if self.cfg.async_mode:
-            return result.policy_logprobs
+            return result.logprobs
         T = result.completions.shape[1]
         lp, _ = self._jit_logprobs(
             self.state.params, result.sequences, result.prompt_lens,
@@ -249,7 +252,7 @@ class BaseTrainer:
     # ------------------------------------------------------------------
     # checkpoint/resume (SURVEY.md §2 #17)
     # ------------------------------------------------------------------
-    def _extra_state(self, prompt_iter=None) -> dict:
+    def _extra_state(self, prompt_iter=None, data_state=None) -> dict:
         extra = {
             "global_iter": self.global_iter,
             "rng": np.asarray(jax.random.key_data(self._rng)).tolist(),
@@ -258,16 +261,20 @@ class BaseTrainer:
         kl_ctl = getattr(self, "kl_ctl", None)
         if kl_ctl is not None:
             extra["kl_coef"] = float(kl_ctl.value)
-        if prompt_iter is not None and hasattr(prompt_iter, "state"):
+        if data_state is not None:
+            # Pre-snapshotted cursor (async mode: taken on the rollout
+            # thread, the iterator's only consumer).
+            extra["data"] = data_state
+        elif prompt_iter is not None and hasattr(prompt_iter, "state"):
             extra["data"] = prompt_iter.state()
         return extra
 
-    def save_checkpoint(self, prompt_iter=None) -> None:
+    def save_checkpoint(self, prompt_iter=None, data_state=None) -> None:
         if self.ckpt is None:
             raise ValueError("configure checkpoint_dir + checkpoint_every")
         self.ckpt.save(self.global_iter, self.state,
                        critic_state=getattr(self, "critic_state", None),
-                       extra=self._extra_state(prompt_iter))
+                       extra=self._extra_state(prompt_iter, data_state))
 
     def resume(self, prompt_iter=None) -> bool:
         """Restore the latest checkpoint if one exists.  Returns True if

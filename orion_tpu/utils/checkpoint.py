@@ -41,6 +41,13 @@ class CheckpointManager:
     # ------------------------------------------------------------------
     def save(self, step: int, state: Any, critic_state: Any = None,
              extra: Optional[dict] = None) -> None:
+        # Device-side snapshot before handing to the async writer: the
+        # trainer's next update step *donates* the state buffers, and a
+        # donated buffer is deleted even while orbax still references it
+        # (jax donation ignores Python refcounts).  The copy preserves
+        # shardings and is HBM→HBM, so it's cheap relative to the write.
+        state = _device_copy(state)
+        critic_state = _device_copy(critic_state)
         items = {"state": ocp.args.StandardSave(state)}
         if critic_state is not None:
             items["critic_state"] = ocp.args.StandardSave(critic_state)
@@ -80,6 +87,15 @@ class CheckpointManager:
     def close(self) -> None:
         self._mgr.wait_until_finished()
         self._mgr.close()
+
+
+def _device_copy(tree: Any) -> Any:
+    if tree is None:
+        return None
+    import jax.numpy as jnp
+
+    return jax.tree.map(
+        lambda x: jnp.copy(x) if isinstance(x, jax.Array) else x, tree)
 
 
 def _jsonable(tree: Any) -> Any:

@@ -12,11 +12,11 @@ from orion_tpu.rollout import RolloutEngine
 from orion_tpu.rollout.continuous import ContinuousBatchingEngine
 
 
-def _setup(eos=None, max_new=10, slots=2):
+def _setup(eos=None, max_new=10, slots=2, max_prompt=12):
     cfg = ModelConfig.tiny(dtype="float32")
     model = Transformer(cfg)
     params = init_params(model, jax.random.key(0), cfg)
-    rcfg = RolloutConfig(max_prompt_len=12, max_new_tokens=max_new,
+    rcfg = RolloutConfig(max_prompt_len=max_prompt, max_new_tokens=max_new,
                          temperature=0.0, page_size=4, max_batch_size=slots)
     eng = ContinuousBatchingEngine(model, cfg, rcfg, eos_token_id=eos,
                                    segment_len=4)
@@ -70,6 +70,27 @@ def test_continuous_eos_and_recycling():
     # All pages recycled at the end.
     assert eng.sched.free_pages == eng.num_pages
     assert eng.sched.running == 0 and eng.sched.waiting == 0
+
+
+def test_continuous_short_reservation_no_prompt_clobber():
+    """max_new_tokens << max_prompt_len: the page reservation is smaller
+    than the block-table width, so prefill's pad-position writes spill
+    past the reserved pages.  They must land on the scratch page — not
+    wrap onto the request's last real page and clobber prompt KV
+    (ADVICE r1 high; this exact shape was previously untested)."""
+    cfg, model, params, eng, solo = _setup(max_new=2, max_prompt=16,
+                                           slots=2)
+    rng = np.random.RandomState(7)
+    # Prompts short enough that ceil((plen+2)/4) < ceil(16/4) pages.
+    reqs = [(i, rng.randint(1, cfg.vocab_size, rng.randint(3, 8)))
+            for i in range(5)]
+    out = eng.generate(reqs, jax.random.key(4), params)
+    assert sorted(r.req_id for r in out) == list(range(5))
+    for r in out:
+        ids = dict(reqs)[r.req_id]
+        expect = _solo_completion(solo, np.asarray(ids, np.int32), 2)
+        np.testing.assert_array_equal(r.tokens, expect,
+                                      err_msg=f"req {r.req_id}")
 
 
 def test_continuous_rejects_oversized_prompt():

@@ -1,0 +1,150 @@
+"""scan_layers: lax.scan over a stacked block stack (VERDICT r1 weak #4
+— previously a dead flag).  Numerics must match the unrolled model
+exactly; the rollout engine and sharded training must work unchanged."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from orion_tpu.config import GRPOConfig, MeshConfig, ModelConfig, RolloutConfig
+from orion_tpu.models import Transformer, init_params
+from orion_tpu.models.hf_loader import stack_layer_params, unstack_layer_params
+from orion_tpu.rollout import RolloutEngine
+
+from test_trainers import lucky_token_reward, prompt_stream, _mk
+
+
+def _cfg(**kw):
+    return ModelConfig.tiny(dtype="float32", num_layers=3, **kw)
+
+
+def _stacked_from(params, num_layers):
+    host = jax.tree.map(np.asarray, params)
+    return stack_layer_params(dict(host), num_layers)
+
+
+def test_scan_forward_matches_unrolled():
+    cfg_u, cfg_s = _cfg(), _cfg(scan_layers=True)
+    params_u = init_params(Transformer(cfg_u), jax.random.key(0), cfg_u)
+    params_s = _stacked_from(params_u, cfg_u.num_layers)
+    B, L = 2, 16
+    ids = jax.random.randint(jax.random.key(1), (B, L), 0, cfg_u.vocab_size)
+    pos = jnp.broadcast_to(jnp.arange(L, dtype=jnp.int32), (B, L))
+    lu, _ = Transformer(cfg_u).apply({"params": params_u}, ids, pos)
+    ls, _ = Transformer(cfg_s).apply({"params": params_s}, ids, pos)
+    np.testing.assert_allclose(np.asarray(ls), np.asarray(lu),
+                               rtol=1e-6, atol=1e-6)
+    # Round trip back to the unrolled layout reproduces the unrolled
+    # model bit-exactly (same graph, same param values).
+    back = unstack_layer_params(dict(params_s), cfg_u.num_layers)
+    lb, _ = Transformer(cfg_u).apply({"params": back}, ids, pos)
+    np.testing.assert_array_equal(np.asarray(lb), np.asarray(lu))
+
+
+def test_scan_init_param_shapes():
+    cfg_s = _cfg(scan_layers=True)
+    params = init_params(Transformer(cfg_s), jax.random.key(0), cfg_s)
+    kern = params["layers"]["attn"]["q_proj"]["kernel"]
+    assert kern.shape[0] == cfg_s.num_layers
+    assert "layers_0" not in params
+
+
+def test_scan_rollout_engine_greedy_parity():
+    cfg_u, cfg_s = _cfg(), _cfg(scan_layers=True)
+    params_u = init_params(Transformer(cfg_u), jax.random.key(2), cfg_u)
+    params_s = _stacked_from(params_u, cfg_u.num_layers)
+    rc = RolloutConfig(max_prompt_len=8, max_new_tokens=8, temperature=0.0)
+    outs = {}
+    for tag, cfg, params in (("u", cfg_u, params_u), ("s", cfg_s, params_s)):
+        eng = RolloutEngine(Transformer(cfg), cfg, rc, eos_token_id=None)
+        eng.load_weights(params)
+        ids = jnp.asarray(np.random.RandomState(0).randint(1, 256, (2, 8)),
+                          jnp.int32)
+        r = eng.generate(ids, jnp.full((2,), 8, jnp.int32), jax.random.key(3))
+        outs[tag] = np.asarray(r.completions)
+    np.testing.assert_array_equal(outs["u"], outs["s"])
+
+
+def test_scan_paged_engine_greedy_parity():
+    cfg_s = _cfg(scan_layers=True)
+    params_s = _stacked_from(
+        init_params(Transformer(_cfg()), jax.random.key(2), _cfg()),
+        cfg_s.num_layers)
+    ids = jnp.asarray(np.random.RandomState(1).randint(1, 256, (2, 8)),
+                      jnp.int32)
+    outs = {}
+    for paged in (False, True):
+        rc = RolloutConfig(max_prompt_len=8, max_new_tokens=8,
+                           temperature=0.0, paged=paged, page_size=4)
+        eng = RolloutEngine(Transformer(cfg_s), cfg_s, rc, eos_token_id=None)
+        eng.load_weights(params_s)
+        r = eng.generate(ids, jnp.full((2,), 8, jnp.int32), jax.random.key(4))
+        outs[paged] = np.asarray(r.completions)
+    np.testing.assert_array_equal(outs[False], outs[True])
+
+
+def test_scan_continuous_engine_matches_solo():
+    from orion_tpu.rollout.continuous import ContinuousBatchingEngine
+
+    cfg_s = _cfg(scan_layers=True)
+    params_s = _stacked_from(
+        init_params(Transformer(_cfg()), jax.random.key(2), _cfg()),
+        cfg_s.num_layers)
+    model = Transformer(cfg_s)
+    rc = RolloutConfig(max_prompt_len=8, max_new_tokens=6, temperature=0.0,
+                       page_size=4, max_batch_size=2)
+    eng = ContinuousBatchingEngine(model, cfg_s, rc, eos_token_id=None,
+                                   segment_len=3)
+    solo = RolloutEngine(model, cfg_s,
+                         RolloutConfig(max_new_tokens=6, temperature=0.0),
+                         eos_token_id=None)
+    solo.load_weights(params_s)
+    rng = np.random.RandomState(0)
+    reqs = [(i, rng.randint(1, cfg_s.vocab_size, rng.randint(3, 8)))
+            for i in range(4)]
+    out = eng.generate(reqs, jax.random.key(1), params_s)
+    assert sorted(r.req_id for r in out) == list(range(4))
+    for r in out:
+        ids = np.asarray(dict(reqs)[r.req_id], np.int32)
+        sr = solo.generate(jnp.asarray(ids[None, :]),
+                           jnp.asarray([len(ids)], np.int32),
+                           jax.random.key(0))
+        n = int(sr.completion_lens[0])
+        np.testing.assert_array_equal(
+            r.tokens, np.asarray(sr.completions[0, :n]),
+            err_msg=f"req {r.req_id}")
+
+
+def test_scan_grpo_trains_with_remat():
+    cfg = _mk(GRPOConfig, group_size=2, num_epochs=1, minibatch_size=4)
+    cfg.model = ModelConfig.tiny(dtype="float32", num_layers=2,
+                                 vocab_size=32, hidden_size=32,
+                                 intermediate_size=64, num_heads=2,
+                                 num_kv_heads=2, scan_layers=True,
+                                 remat=True)
+    from orion_tpu.trainers import GRPOTrainer
+
+    model = Transformer(cfg.model)
+    params = init_params(model, jax.random.key(0), cfg.model)
+    trainer = GRPOTrainer(cfg, model, params,
+                          reward_fn=lucky_token_reward, eos_token_id=None)
+    hist = trainer.train(prompt_stream(2, 4), num_iterations=2)
+    assert len(hist) == 2 and np.isfinite(hist[-1]["loss"])
+
+
+def test_scan_sharded_model_on_mesh():
+    from orion_tpu.models.sharded import make_sharded_model
+    from orion_tpu.parallel.mesh import make_mesh
+
+    cfg = ModelConfig.tiny(dtype="float32", num_layers=2, hidden_size=64,
+                           num_heads=4, num_kv_heads=2, scan_layers=True)
+    mesh = make_mesh(MeshConfig(data=1, fsdp=2, seq=1, tensor=2),
+                     jax.devices()[:4])
+    init_args = (jnp.zeros((1, 2), jnp.int32), jnp.zeros((1, 2), jnp.int32))
+    params, shardings = make_sharded_model(Transformer(cfg), mesh,
+                                           jax.random.key(0), init_args)
+    kern = params["layers"]["attn"]["q_proj"]["kernel"]
+    assert kern.shape[0] == cfg.num_layers
+    # Leading "layers" axis replicated; heads axis tensor-sharded.
+    spec = kern.sharding.spec
+    assert spec[0] is None and "tensor" in str(spec)
