@@ -161,6 +161,10 @@ class OptimizerConfig:
     eps: float = 1e-8
     weight_decay: float = 0.0
     grad_clip: float = 1.0
+    # Adam first-moment dtype (None => param dtype).  bf16 halves the
+    # moment's HBM residency — the difference between a 1B-model RLHF
+    # session (policy+ref+critic+moments) fitting on one 16G chip or not.
+    mu_dtype: Optional[str] = None
     warmup_steps: int = 0
     total_steps: int = 0  # 0 => constant lr after warmup
     schedule: str = "constant"  # "constant" | "linear" | "cosine"
@@ -245,6 +249,12 @@ class TrainConfig:
     checkpoint_keep: int = 3
     log_every: int = 1
     log_dir: Optional[str] = None  # jsonl (+tensorboard) metrics stream
+    # Profiling (SURVEY.md §5 tracing): capture a jax.profiler trace
+    # (xplane + perfetto) of `profile_steps` iterations, starting at
+    # `profile_start` (default 1 = first post-compile iteration).
+    profile_dir: Optional[str] = None
+    profile_steps: int = 2
+    profile_start: int = 1
     # Async mode (SPEC config 4).
     async_mode: bool = False
     async_staleness: int = 1  # max steps rollout weights may lag

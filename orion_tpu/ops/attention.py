@@ -40,6 +40,28 @@ def reference_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     return out
 
 
+def reference_attention_gqa(q: jnp.ndarray, k: jnp.ndarray,
+                            v: jnp.ndarray, mask: jnp.ndarray,
+                            scale: float) -> jnp.ndarray:
+    """GQA without materializing repeated KV heads: query heads are
+    grouped per KV head inside the einsum, so the [B, L, H, D]-sized
+    KV expansion never hits HBM (it matters in the decode loop, where
+    the expansion would be re-written every step).  Matches
+    ``reference_attention(q, repeat_kv(k), repeat_kv(v), ...)``."""
+    B, Lq, H, D = q.shape
+    Hkv = k.shape[2]
+    g = H // Hkv
+    if g == 1:
+        return reference_attention(q, k, v, mask, scale)
+    qg = q.reshape(B, Lq, Hkv, g, D)
+    scores = jnp.einsum("bqhgd,bkhd->bhgqk", qg, k,
+                        preferred_element_type=jnp.float32) * scale
+    scores = jnp.where(mask[:, None, None, :, :], scores, _NEG_INF)
+    probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1)
+    out = jnp.einsum("bhgqk,bkhd->bqhgd", probs.astype(q.dtype), v)
+    return out.reshape(B, Lq, H, D)
+
+
 def attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
               mask: jnp.ndarray, scale: float,
               impl: str = "reference",
@@ -90,6 +112,4 @@ def attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
             raise ValueError("flash attention requires q_positions")
         from orion_tpu.ops.pallas.flash_attention import flash_attention_gqa
         return flash_attention_gqa(q, k, v, q_positions, scale)
-    k = repeat_kv(k, n_rep)
-    v = repeat_kv(v, n_rep)
-    return reference_attention(q, k, v, mask, scale)
+    return reference_attention_gqa(q, k, v, mask, scale)

@@ -62,6 +62,9 @@ def sample_tokens(rng: jax.Array, logits: jnp.ndarray, temperature: float,
         logits = _mask_top_k(logits, top_k)
     if top_p < 1.0:
         logits = _mask_top_p(logits, top_p)
-    logps = jax.nn.log_softmax(logits, axis=-1)
+    if temperature == 1.0 and top_k <= 0 and top_p >= 1.0:
+        logps = raw_logps  # sampling dist == policy dist: one softmax
+    else:
+        logps = jax.nn.log_softmax(logits, axis=-1)
     tokens = jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
     return tokens, take(logps, tokens), take(raw_logps, tokens)

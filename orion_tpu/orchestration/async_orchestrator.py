@@ -159,14 +159,15 @@ class AsyncOrchestrator:
                 self._rng, sub = jax.random.split(self._rng)
                 result = self.engine.generate(
                     np.asarray(ids), np.asarray(lens), sub, params=params)
-                scores = np.asarray(self.trainer.score(result, meta))
                 # Host staging: the experience crosses the group boundary
-                # as numpy; the learner's jitted programs re-place it on
-                # the train mesh.
-                result_host = {
-                    f.name: np.asarray(getattr(result, f.name))
-                    for f in dataclasses.fields(result)}
-                item = _Item(result_host, scores, version, data_state)
+                # as numpy (ONE batched fetch); the learner's jitted
+                # programs re-place it on the train mesh.
+                host = result.to_host()
+                wants_device = getattr(self.trainer.reward_fn,
+                                       "wants_device_result", False)
+                scores = self.trainer.score(
+                    result if wants_device else host, meta)
+                item = _Item(host._fields(), scores, version, data_state)
                 while not self._stop.is_set():
                     try:
                         self._queue.put(item, timeout=0.1)

@@ -14,6 +14,11 @@ from orion_tpu.rollout import GenerationResult
 
 
 class ModelReward:
+    # Score on device: trainers pass the device result (not the host
+    # copy) so sequences aren't re-uploaded; only the [B] scalar scores
+    # cross back to host.
+    wants_device_result = True
+
     def __init__(self, model: ScalarHeadModel, params: Any):
         self.model = model
         self.params = params

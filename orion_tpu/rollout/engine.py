@@ -47,6 +47,18 @@ class GenerationResult:
     prompt_lens: jnp.ndarray      # [B]
     total_lens: jnp.ndarray       # [B] prompt + completion lengths
 
+    def _fields(self) -> dict:
+        return {f.name: getattr(self, f.name)
+                for f in dataclasses.fields(self)}
+
+    def to_host(self) -> "GenerationResult":
+        """Numpy copy of every field via ONE batched device→host
+        transfer.  On a tunneled TPU every separate fetch pays a full
+        round-trip (~100 ms measured); host consumers (reward fns,
+        stats, detokenization) must use this copy, never per-field
+        ``np.asarray``."""
+        return GenerationResult(**jax.device_get(self._fields()))
+
 
 class RolloutEngine:
     """Batched autoregressive generation with KV cache + logprob capture."""
@@ -116,8 +128,9 @@ class RolloutEngine:
             cache = init_cache(self.model_cfg, B, P + T,
                                dtype=jnp.dtype(self.model_cfg.dtype))
         positions = jnp.broadcast_to(jnp.arange(P, dtype=jnp.int32), (B, P))
-        logits, cache = self.model.apply(
-            {"params": params}, prompt_ids, positions, cache)
+        with jax.named_scope("prefill"):
+            logits, cache = self.model.apply(
+                {"params": params}, prompt_ids, positions, cache)
 
         # logits at the last real prompt token predict completion[0]
         last = jnp.take_along_axis(
@@ -157,8 +170,9 @@ class RolloutEngine:
 
         init = (jnp.int32(1), tok0, prompt_lens, rng, done, tokens, logps,
                 plogps, (cache, comp_len))
-        _, _, _, _, done, tokens, logps, plogps, (cache, comp_len) = \
-            jax.lax.while_loop(cond, body, init)
+        with jax.named_scope("decode"):
+            _, _, _, _, done, tokens, logps, plogps, (cache, comp_len) = \
+                jax.lax.while_loop(cond, body, init)
 
         mask = (jnp.arange(T)[None, :] < comp_len[:, None]).astype(jnp.float32)
         sequences = pack_sequences(prompt_ids, prompt_lens, tokens)

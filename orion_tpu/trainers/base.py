@@ -61,7 +61,8 @@ def make_schedule(cfg: OptimizerConfig):
 
 def make_optimizer(cfg: OptimizerConfig) -> optax.GradientTransformation:
     tx = optax.adamw(make_schedule(cfg), b1=cfg.betas[0], b2=cfg.betas[1],
-                     eps=cfg.eps, weight_decay=cfg.weight_decay)
+                     eps=cfg.eps, weight_decay=cfg.weight_decay,
+                     mu_dtype=cfg.mu_dtype)
     if cfg.grad_clip > 0:
         tx = optax.chain(optax.clip_by_global_norm(cfg.grad_clip), tx)
     return tx
@@ -109,6 +110,7 @@ class BaseTrainer:
         self._jit_logprobs = jax.jit(
             self._logprobs_fn, static_argnames=("max_new",))
         self._jit_update = jax.jit(self._update_fn, donate_argnums=(0,))
+        self._jit_epochs = jax.jit(self._epochs_fn, donate_argnums=(0,))
         self.global_iter = 0
         self.ckpt = None
         if cfg.checkpoint_dir and cfg.checkpoint_every:
@@ -161,16 +163,21 @@ class BaseTrainer:
         return sub
 
     def generate(self, prompt_ids, prompt_lens) -> GenerationResult:
-        return self.engine.generate(
-            jnp.asarray(prompt_ids), jnp.asarray(prompt_lens),
-            self.next_rng(), params=self.state.params)
+        # One batched host→device transfer for both prompt arrays.
+        ids, lens = jax.device_put((np.asarray(prompt_ids),
+                                    np.asarray(prompt_lens)))
+        return self.engine.generate(ids, lens, self.next_rng(),
+                                    params=self.state.params)
 
-    def score(self, result: GenerationResult, batch: dict) -> jnp.ndarray:
-        """Sequence-level scores [B] (f32, on host or device)."""
+    def score(self, result: GenerationResult, batch: dict) -> np.ndarray:
+        """Sequence-level scores [B] as host f32.  ``result`` should be
+        the host copy (``GenerationResult.to_host()``) unless the reward
+        fn sets ``wants_device_result`` (model-based rewards score on
+        device and pay one fetch for the scalar scores instead)."""
         if self.reward_fn is None:
             raise ValueError("no reward_fn configured")
         scores = self.reward_fn(result, batch)
-        return jnp.asarray(np.asarray(scores), jnp.float32)
+        return np.asarray(scores, np.float32).reshape(-1)
 
     def prepare_prompts(self, batch: dict):
         """(prompt_ids, prompt_lens, meta) — group trainers (GRPO/RLOO/
@@ -208,24 +215,42 @@ class BaseTrainer:
             max_new=T)
         return lp
 
-    def build_experience(self, result: GenerationResult, scores):
+    def build_experience(self, result: GenerationResult, scores,
+                         host: Optional[GenerationResult] = None):
         """(experience dict, stats dict) from a finished generation.
-        Algorithm-specific; must not generate (async mode calls it on the
-        learner with a result produced by the rollout worker)."""
+
+        ``result`` — device (or host, in async mode) arrays for the
+        jitted experience math; ``scores`` — host np [B]; ``host`` — the
+        one-fetch host copy for stats (falls back to ``result``).
+        Algorithm-specific; must not generate (async mode calls it on
+        the learner with a result produced by the rollout worker)."""
         raise NotImplementedError
 
     def make_experience(self, batch: dict):
         """Synchronous pipeline front half: prompts → generate → score →
-        experience (SURVEY.md §3a)."""
+        experience (SURVEY.md §3a).  Exactly one device→host fetch of
+        the generation (plus one scalar fetch for model-based rewards)."""
         ids, lens, meta = self.prepare_prompts(batch)
         result = self.generate(ids, lens)
-        scores = self.score(result, meta)
-        return self.build_experience(result, scores)
+        host = result.to_host()
+        wants_device = getattr(self.reward_fn, "wants_device_result", False)
+        scores = self.score(result if wants_device else host, meta)
+        return self.build_experience(result, scores, host=host)
 
-    def _apply_update(self, experience, idx) -> dict:
-        """One minibatch step.  Subclasses with extra train states (PPO's
-        critic) override this hook; the epoch loop stays in one place."""
-        self.state, stats = self._jit_update(self.state, experience, idx)
+    def _epochs_fn(self, state: TrainState, experience, idx_mat):
+        """All epochs×minibatches as ONE program: lax.scan threads the
+        TrainState through every minibatch update.  One dispatch, one
+        H2D (idx_mat), one D2H (stacked stats) per update_epochs call —
+        per-minibatch host round-trips cost ~100 ms each on a tunneled
+        TPU and used to dominate the update wall-clock (5x)."""
+        return jax.lax.scan(
+            lambda st, idx: self._update_fn(st, experience, idx),
+            state, idx_mat)
+
+    def _run_epochs(self, experience, idx_mat):
+        """Dispatch the scanned epoch program; PPO (extra critic state)
+        overrides this hook.  Returns stacked per-minibatch stats."""
+        self.state, stats = self._jit_epochs(self.state, experience, idx_mat)
         return stats
 
     def update_epochs(self, experience: Dict[str, jnp.ndarray]) -> dict:
@@ -233,15 +258,12 @@ class BaseTrainer:
         B = int(experience["prompt_lens"].shape[0])
         mb = self.cfg.minibatch_size
         assert B % mb == 0, f"batch {B} not divisible by minibatch {mb}"
-        agg: Dict[str, list] = {}
-        for _ in range(self.cfg.num_epochs):
-            perm = self._np_rng.permutation(B)
-            for i in range(0, B, mb):
-                idx = jnp.asarray(perm[i:i + mb])
-                stats = self._apply_update(experience, idx)
-                for k, v in stats.items():
-                    agg.setdefault(k, []).append(float(v))
-        return {k: float(np.mean(v)) for k, v in agg.items()}
+        perms = np.stack([self._np_rng.permutation(B)
+                          for _ in range(self.cfg.num_epochs)])
+        idx_mat = jnp.asarray(perms.reshape(-1, mb).astype(np.int32))
+        stats = self._run_epochs(experience, idx_mat)
+        host = jax.device_get(stats)  # ONE batched transfer
+        return {k: float(np.mean(v)) for k, v in host.items()}
 
     def sync_weights(self) -> None:
         """Trainer → rollout weight sync (SURVEY.md §2 #11).  Sync mode:
@@ -318,12 +340,16 @@ class BaseTrainer:
             n = num_iterations
         else:
             n = max(0, self.cfg.total_iterations - self.global_iter)
+        prof = _ProfileWindow(self.cfg)
         for it in range(n):
+            prof.step(it)
             t0 = time.perf_counter()
             batch = next(prompt_iter)
-            experience, exp_stats = self.make_experience(batch)
+            with jax.named_scope("experience"):
+                experience, exp_stats = self.make_experience(batch)
             t1 = time.perf_counter()
-            stats = self.update_epochs(experience)
+            with jax.named_scope("update"):
+                stats = self.update_epochs(experience)
             self.sync_weights()
             t2 = time.perf_counter()
             stats.update(exp_stats)
@@ -343,6 +369,7 @@ class BaseTrainer:
             if self.ckpt is not None and \
                     self.global_iter % self.cfg.checkpoint_every == 0:
                 self.save_checkpoint(prompt_iter)
+        prof.stop()
         if self.ckpt is not None:
             self.ckpt.wait()
         return self.metrics_history
@@ -351,6 +378,33 @@ class BaseTrainer:
         keys = ("iteration", "reward_mean", "loss", "kl", "samples_per_sec")
         msg = " ".join(f"{k}={stats[k]:.4g}" for k in keys if k in stats)
         print(f"[orion-tpu] {msg}", flush=True)
+
+
+class _ProfileWindow:
+    """Starts/stops a jax.profiler trace over the configured iteration
+    window (SURVEY.md §5 tracing).  Dumps xplane + perfetto trace under
+    ``cfg.profile_dir`` — viewable in tensorboard / Perfetto."""
+
+    def __init__(self, cfg: TrainConfig):
+        self.dir = cfg.profile_dir
+        self.start_it = cfg.profile_start
+        self.stop_it = cfg.profile_start + cfg.profile_steps
+        self.active = False
+
+    def step(self, it: int) -> None:
+        if self.dir is None:
+            return
+        if it == self.start_it:
+            jax.profiler.start_trace(self.dir)
+            self.active = True
+        elif it == self.stop_it and self.active:
+            jax.profiler.stop_trace()
+            self.active = False
+
+    def stop(self) -> None:
+        if self.active:
+            jax.profiler.stop_trace()
+            self.active = False
 
 
 def _np_state_to_json(state: tuple) -> list:

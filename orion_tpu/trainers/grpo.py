@@ -23,7 +23,7 @@ from orion_tpu.trainers.base import BaseTrainer
 class GRPOTrainer(BaseTrainer):
     cfg: GRPOConfig
 
-    def build_experience(self, result, scores):
+    def build_experience(self, result, scores, host=None):
         k = self.cfg.group_size
         T = result.completions.shape[1]
         # Sync: old logprobs recomputed under the *training* graph so the
@@ -34,7 +34,8 @@ class GRPOTrainer(BaseTrainer):
             self.ref_params, result.sequences, result.prompt_lens, max_new=T)
 
         adv_seq = grpo_advantages(
-            scores, k, normalize_std=(self.cfg.variant == "grpo"))
+            jnp.asarray(scores), k,
+            normalize_std=(self.cfg.variant == "grpo"))
         experience = {
             "sequences": result.sequences,
             "prompt_lens": result.prompt_lens,
@@ -46,10 +47,11 @@ class GRPOTrainer(BaseTrainer):
             "ref_logprobs": ref_lp,
             "advantages": adv_seq[:, None] * result.completion_mask,
         }
-        stats = {
-            "reward_mean": float(jnp.mean(scores)),
-            "reward_std": float(jnp.std(scores)),
-            "completion_len_mean": float(jnp.mean(result.completion_lens)),
+        lens = (host or result).completion_lens
+        stats = {  # host-side: no device fetches
+            "reward_mean": float(np.mean(scores)),
+            "reward_std": float(np.std(scores)),
+            "completion_len_mean": float(np.mean(np.asarray(lens))),
         }
         return experience, stats
 

@@ -7,6 +7,7 @@ from __future__ import annotations
 
 from typing import Dict
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -18,13 +19,15 @@ from orion_tpu.trainers.base import BaseTrainer
 class OnlineDPOTrainer(BaseTrainer):
     cfg: OnlineDPOConfig
 
-    def build_experience(self, result, scores):
+    def build_experience(self, result, scores, host=None):
         assert self.cfg.group_size == 2, "online DPO samples pairs"
         scores = np.asarray(scores)  # [2N]
+        host = host or result
         T = result.completions.shape[1]
         ref_lp, _ = self._jit_logprobs(
             self.ref_params, result.sequences, result.prompt_lens, max_new=T)
-        ref_seq_lp = np.asarray(
+        # one scalar-array fetch (ref logprobs live on device)
+        ref_seq_lp = jax.device_get(
             jnp.sum(ref_lp * result.completion_mask, axis=1))
 
         # rank within each consecutive pair; tied pairs get weight 0
@@ -38,12 +41,11 @@ class OnlineDPOTrainer(BaseTrainer):
         c_idx = rows + chosen_col
         r_idx = rows + (1 - chosen_col)
 
-        def gather(x):
-            return np.asarray(x)
-
-        seqs = gather(result.sequences)
-        mask = gather(result.completion_mask)
-        lens = gather(result.prompt_lens)
+        # Pair gathers run on the (already fetched) host copy; the
+        # experience tree crosses back host→device at the update jit.
+        seqs = np.asarray(host.sequences)
+        mask = np.asarray(host.completion_mask)
+        lens = np.asarray(host.prompt_lens)
         experience = {
             "chosen_sequences": jnp.asarray(seqs[c_idx]),
             "rejected_sequences": jnp.asarray(seqs[r_idx]),
@@ -60,7 +62,7 @@ class OnlineDPOTrainer(BaseTrainer):
             "reward_margin": float(
                 np.abs(pair_scores[:, 0] - pair_scores[:, 1]).mean()),
             "completion_len_mean": float(
-                np.asarray(result.completion_lens).mean()),
+                np.asarray(host.completion_lens).mean()),
         }
         return experience, stats
 

@@ -16,6 +16,7 @@ from typing import Any, Dict
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 import optax
 
 from orion_tpu.algos import (AdaptiveKLController, FixedKLController, gae,
@@ -41,7 +42,7 @@ class PPOTrainer(BaseTrainer):
                        if cfg.adaptive_kl else FixedKLController(cfg.kl_coef))
 
         self._jit_values = jax.jit(self._values_fwd)
-        self._jit_ppo_update = jax.jit(self._ppo_update_fn,
+        self._jit_ppo_epochs = jax.jit(self._ppo_epochs_fn,
                                        donate_argnums=(0, 1))
 
     def _values_fwd(self, critic_params, sequences, prompt_lens, mask):
@@ -61,7 +62,7 @@ class PPOTrainer(BaseTrainer):
         return jnp.take_along_axis(values, idx, axis=1) * mask
 
     # ------------------------------------------------------------------
-    def build_experience(self, result, scores):
+    def build_experience(self, result, scores, host=None):
         T = result.completions.shape[1]
         mask = result.completion_mask
         old_lp = self.behavior_logprobs(result)
@@ -72,14 +73,20 @@ class PPOTrainer(BaseTrainer):
             mask)
 
         kl = kl_penalty(old_lp, ref_lp, "k1") * mask
-        rewards = per_token_rewards(scores, kl, mask, self.kl_ctl.value,
-                                    self.cfg.reward_clip)
+        rewards = per_token_rewards(jnp.asarray(scores), kl, mask,
+                                    self.kl_ctl.value, self.cfg.reward_clip)
         advantages, returns = gae(rewards, values, mask,
                                   self.cfg.gamma, self.cfg.gae_lambda)
         if self.cfg.whiten_advantages:
             advantages = masked_whiten(advantages, mask)
 
-        mean_kl = float(masked_mean(kl, mask))
+        # One batched fetch for every device scalar this step needs.
+        dev = jax.device_get({
+            "kl": masked_mean(kl, mask),
+            "value_mean": masked_mean(values, mask),
+            "return_mean": masked_mean(returns, mask),
+        })
+        mean_kl = float(dev["kl"])
         self.kl_ctl.update(mean_kl, int(mask.shape[0]))
 
         experience = {
@@ -91,14 +98,15 @@ class PPOTrainer(BaseTrainer):
             "advantages": advantages,
             "returns": returns,
         }
+        lens = (host or result).completion_lens
         stats = {
-            "reward_mean": float(jnp.mean(scores)),
-            "reward_std": float(jnp.std(scores)),
+            "reward_mean": float(np.mean(scores)),
+            "reward_std": float(np.std(scores)),
             "kl": mean_kl,
             "kl_coef": self.kl_ctl.value,
-            "value_mean": float(masked_mean(values, mask)),
-            "return_mean": float(masked_mean(returns, mask)),
-            "completion_len_mean": float(jnp.mean(result.completion_lens)),
+            "value_mean": float(dev["value_mean"]),
+            "return_mean": float(dev["return_mean"]),
+            "completion_len_mean": float(np.mean(np.asarray(lens))),
         }
         return experience, stats
 
@@ -146,7 +154,19 @@ class PPOTrainer(BaseTrainer):
         stats["grad_norm"] = optax.global_norm(p_grads)
         return new_state, new_critic, stats
 
-    def _apply_update(self, experience, idx) -> dict:
-        self.state, self.critic_state, stats = self._jit_ppo_update(
-            self.state, self.critic_state, experience, idx)
+    def _ppo_epochs_fn(self, state, critic_state, experience, idx_mat):
+        """Scanned joint policy/critic epoch program (one dispatch for
+        all minibatches — see BaseTrainer._epochs_fn)."""
+        def step(carry, idx):
+            st, cst = carry
+            st, cst, stats = self._ppo_update_fn(st, cst, experience, idx)
+            return (st, cst), stats
+
+        (st, cst), stats = jax.lax.scan(
+            step, (state, critic_state), idx_mat)
+        return st, cst, stats
+
+    def _run_epochs(self, experience, idx_mat):
+        self.state, self.critic_state, stats = self._jit_ppo_epochs(
+            self.state, self.critic_state, experience, idx_mat)
         return stats

@@ -21,7 +21,7 @@ from orion_tpu.trainers.base import BaseTrainer
 class RLOOTrainer(BaseTrainer):
     cfg: RLOOConfig
 
-    def build_experience(self, result, scores):
+    def build_experience(self, result, scores, host=None):
         k = self.cfg.group_size
         T = result.completions.shape[1]
         mask = result.completion_mask
@@ -30,8 +30,8 @@ class RLOOTrainer(BaseTrainer):
             self.ref_params, result.sequences, result.prompt_lens, max_new=T)
 
         kl_seq = jnp.sum(kl_penalty(old_lp, ref_lp, "k1") * mask, axis=1)
-        adjusted = scores - (self.cfg.kl_coef * kl_seq
-                             if self.cfg.kl_in_reward else 0.0)
+        adjusted = jnp.asarray(scores) - (self.cfg.kl_coef * kl_seq
+                                          if self.cfg.kl_in_reward else 0.0)
         adv = rloo_advantages(adjusted, k)
 
         experience = {
@@ -41,10 +41,12 @@ class RLOOTrainer(BaseTrainer):
             "old_logprobs": old_lp * mask,
             "advantages": adv,  # [B] sequence-level
         }
+        lens = (host or result).completion_lens
         stats = {
-            "reward_mean": float(jnp.mean(scores)),
-            "kl": float(jnp.mean(kl_seq)),
-            "completion_len_mean": float(jnp.mean(result.completion_lens)),
+            "reward_mean": float(np.mean(scores)),
+            # one batched scalar fetch (kl lives on device)
+            "kl": float(jax.device_get(jnp.mean(kl_seq))),
+            "completion_len_mean": float(np.mean(np.asarray(lens))),
         }
         return experience, stats
 

@@ -1,0 +1,44 @@
+"""Profiler integration (SURVEY.md §5 tracing, VERDICT r1 missing #4):
+the configured iteration window produces an xplane/perfetto trace
+artifact on disk."""
+
+import glob
+
+import jax
+import numpy as np
+
+from orion_tpu.config import GRPOConfig
+from orion_tpu.models import Transformer, init_params
+from orion_tpu.trainers import GRPOTrainer
+
+from test_trainers import lucky_token_reward, prompt_stream, tiny_model_cfg, _mk
+
+
+def test_profile_window_dumps_trace(tmp_path):
+    cfg = _mk(GRPOConfig, group_size=2, num_epochs=1, minibatch_size=4,
+              profile_dir=str(tmp_path / "prof"), profile_steps=1,
+              profile_start=1)
+    model = Transformer(cfg.model)
+    params = init_params(model, jax.random.key(0), cfg.model)
+    trainer = GRPOTrainer(cfg, model, params,
+                          reward_fn=lucky_token_reward, eos_token_id=None)
+    trainer.train(prompt_stream(2, 4), num_iterations=3)
+    traces = glob.glob(str(tmp_path / "prof" / "**" / "*.xplane.pb"),
+                       recursive=True)
+    assert traces, "no xplane trace artifact written"
+
+
+def test_profile_window_stops_cleanly_midrun(tmp_path):
+    """A run shorter than the window must stop the trace on exit (a
+    dangling profiler session would poison the next start_trace)."""
+    cfg = _mk(GRPOConfig, group_size=2, num_epochs=1, minibatch_size=4,
+              profile_dir=str(tmp_path / "prof"), profile_steps=50,
+              profile_start=0)
+    model = Transformer(cfg.model)
+    params = init_params(model, jax.random.key(0), cfg.model)
+    trainer = GRPOTrainer(cfg, model, params,
+                          reward_fn=lucky_token_reward, eos_token_id=None)
+    trainer.train(prompt_stream(2, 4), num_iterations=2)
+    # If the window leaked, this second profiled run would raise.
+    trainer.cfg.profile_dir = str(tmp_path / "prof2")
+    trainer.train(prompt_stream(2, 4), num_iterations=2)
