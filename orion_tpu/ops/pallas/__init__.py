@@ -30,6 +30,14 @@ def target_platform() -> str:
             return m.devices.flat[0].platform
     except Exception:
         pass
+    try:
+        # A `with jax.default_device(dev):` pin (the dryrun's hermetic
+        # CPU fallback) also redirects where unsharded traces execute.
+        dev = jax.config.jax_default_device
+        if dev is not None:
+            return dev.platform
+    except Exception:
+        pass
     return jax.default_backend()
 
 

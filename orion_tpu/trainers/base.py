@@ -109,7 +109,6 @@ class BaseTrainer:
         self._np_rng = np.random.RandomState(cfg.seed)
         self._jit_logprobs = jax.jit(
             self._logprobs_fn, static_argnames=("max_new",))
-        self._jit_update = jax.jit(self._update_fn, donate_argnums=(0,))
         self._jit_epochs = jax.jit(self._epochs_fn, donate_argnums=(0,))
         self.global_iter = 0
         self.ckpt = None
@@ -392,7 +391,7 @@ class _ProfileWindow:
         self.active = False
 
     def step(self, it: int) -> None:
-        if self.dir is None:
+        if self.dir is None or self.stop_it <= self.start_it:
             return
         if it == self.start_it:
             jax.profiler.start_trace(self.dir)

@@ -126,16 +126,22 @@ def test_flash_grad_through_model():
 
 
 def test_auto_resolves_to_reference_off_tpu():
-    """On the CPU harness, impl="auto" must take the exact einsum path."""
-    from orion_tpu.ops.attention import attention
+    """On the CPU harness, impl="auto" must take the exact einsum path
+    (bit-identical to reference_attention_gqa, i.e. no Pallas kernel)."""
+    from orion_tpu.ops.attention import attention, reference_attention_gqa
 
     q, k, v = _make()
     qpos = jnp.broadcast_to(jnp.arange(32, dtype=jnp.int32), (2, 32))
     scale = 1.0 / 16 ** 0.5
     mask = jnp.arange(32)[None, None, :] <= qpos[:, :, None]
     auto = attention(q, k, v, mask, scale, impl="auto", q_positions=qpos)
-    ref = _ref(q, k, v, qpos, scale)
+    ref = jax.jit(reference_attention_gqa, static_argnums=(4,))(
+        q, k, v, mask, scale)
     np.testing.assert_array_equal(np.asarray(auto), np.asarray(ref))
+    # and the grouped einsum itself matches the repeat_kv formulation
+    np.testing.assert_allclose(np.asarray(auto),
+                               np.asarray(_ref(q, k, v, qpos, scale)),
+                               rtol=2e-5, atol=2e-5)
 
 
 def test_auto_routes_to_flash_on_tpu(monkeypatch):
