@@ -1,16 +1,27 @@
-"""Benchmark: GRPO samples/sec (rollout + update) on one TPU chip.
+"""Benchmark: RLHF samples/sec (rollout + update) on one TPU chip.
 
 Prints ONE JSON line:
-  {"metric": ..., "value": N, "unit": "samples/sec", "vs_baseline": N}
+  {"metric": ..., "value": N, "unit": "samples/sec", "vs_baseline": N,
+   "tokens_per_sec": N, "mfu": N, "compile_8b": "..."}
 
-The BASELINE metric (BASELINE.json) is "PPO samples/sec (rollout+update)";
-no published reference number is recoverable (BASELINE.json.published == {},
-empty reference mount — see BASELINE.md), so ``vs_baseline`` is reported
-against the first value this bench ever recorded (BENCH_SELF.json),
-i.e. round-over-round self-improvement, 1.0 on the first run.
+The BASELINE metric (BASELINE.json) is "PPO samples/sec (rollout+update)
+at 1B and 8B".  Default preset on TPU is therefore **ppo1b**: PPO at the
+Pythia-1B shape (shared-backbone critic — the layout that fits
+policy+ref+Adam on one 16G chip), flash attention, remat, scanned
+layers, bf16 Adam moments.  The 8B leg is a compile-only check (AOT
+lowering of the full llama3_8b update step — one chip can't hold 8B
+training state; the multi-chip path is exercised by dryrun_multichip).
 
-Presets (env ORION_BENCH_PRESET): "small" (~320M llama, default on TPU),
-"tiny" (CPU/smoke).
+No published reference number is recoverable (BASELINE.json.published
+== {}, empty reference mount — see BASELINE.md), so ``vs_baseline`` is
+reported against the first value this bench recorded for the SAME
+preset (BENCH_SELF.json), i.e. round-over-round self-improvement, 1.0
+on a preset's first run.
+
+Presets (env ORION_BENCH_PRESET): "ppo1b" (default on TPU), "small"
+(~320M GRPO), "tiny" (CPU/smoke).  ORION_BENCH_ITERS to change the
+measured iteration count; ORION_BENCH_PROFILE=dir to dump a
+jax.profiler trace of the measured window.
 """
 
 from __future__ import annotations
@@ -21,17 +32,49 @@ import time
 
 import numpy as np
 
+V5E_PEAK_FLOPS = 197e12  # bf16 dense, one v5e chip
+
+
+def param_count(tree) -> int:
+    import jax
+
+    return sum(int(np.prod(x.shape)) for x in jax.tree.leaves(tree))
+
+
+def _length_reward(result, batch):
+    # Rule-style host reward: rewards longer distinct completions.
+    toks = np.asarray(result.completions)
+    return np.asarray(
+        [len(np.unique(t)) for t in toks], np.float32) / toks.shape[1]
+
 
 def _preset():
     import jax
 
     name = os.environ.get("ORION_BENCH_PRESET")
     if name is None:
-        name = "small" if jax.default_backend() == "tpu" else "tiny"
-    from orion_tpu.config import GRPOConfig, ModelConfig
+        name = "ppo1b" if jax.default_backend() == "tpu" else "tiny"
+    from orion_tpu.config import (GRPOConfig, ModelConfig, OptimizerConfig,
+                                  PPOConfig)
 
-    cfg = GRPOConfig()
-    if name == "small":
+    if name == "ppo1b":
+        cfg = PPOConfig()
+        cfg.model = ModelConfig.pythia_1b()
+        cfg.model.max_seq_len = 512
+        cfg.model.remat = True
+        cfg.model.scan_layers = True
+        cfg.share_backbone = True
+        cfg.ref_param_dtype = "bfloat16"
+        cfg.optimizer = OptimizerConfig(
+            learning_rate=1e-6, mu_dtype="bfloat16", nu_dtype="bfloat16")
+        cfg.rollout.max_prompt_len = 256
+        cfg.rollout.max_new_tokens = 128
+        cfg.rollout_batch_size = 32
+        cfg.minibatch_size = 4
+        cfg.num_epochs = 1
+        cfg.kl_coef = 0.05
+    elif name == "small":
+        cfg = GRPOConfig()
         # ~320M llama-arch model: real MXU/HBM load, <16G HBM with
         # policy + ref + Adam state resident.
         cfg.model = ModelConfig(
@@ -43,37 +86,143 @@ def _preset():
         cfg.rollout_batch_size = 8
         cfg.group_size = 4
         cfg.minibatch_size = 8
+        cfg.num_epochs = 1
     else:
+        cfg = GRPOConfig()
         cfg.model = ModelConfig.tiny()
         cfg.rollout.max_prompt_len = 16
         cfg.rollout.max_new_tokens = 16
         cfg.rollout_batch_size = 4
         cfg.group_size = 2
         cfg.minibatch_size = 4
-    cfg.num_epochs = 1
+        cfg.num_epochs = 1
     cfg.rollout.temperature = 1.0
     return name, cfg
 
 
-def main() -> None:
+def build_trainer(name, cfg):
+    import jax
+
+    if name == "ppo1b":
+        from orion_tpu.models import ActorCriticModel, init_params
+        from orion_tpu.trainers import PPOTrainer
+
+        model = ActorCriticModel(cfg.model)
+        params = init_params(model, jax.random.key(0), cfg.model)
+        return PPOTrainer(cfg, model, params, reward_fn=_length_reward,
+                          eos_token_id=1, pad_token_id=0)
+    from orion_tpu.models import Transformer, init_params
+    from orion_tpu.trainers import GRPOTrainer
+
+    model = Transformer(cfg.model)
+    params = init_params(model, jax.random.key(0), cfg.model)
+    return GRPOTrainer(cfg, model, params, reward_fn=_length_reward,
+                       eos_token_id=1, pad_token_id=0)
+
+
+def flops_per_sample(n_params, cfg, mean_new: float) -> float:
+    """Model-FLOPs accounting (MFU convention: remat recompute NOT
+    counted).  2N per token forward, 6N per token fwd+bwd; attention
+    term included (small at these lengths)."""
+    m = cfg.model
+    P = cfg.rollout.max_prompt_len
+    seq = P + cfg.rollout.max_new_tokens
+    att_tok = 4.0 * m.num_layers * m.head_dim * m.num_heads * seq
+    fwd_tok = 2.0 * n_params + att_tok
+    # rollout: prefill over P + one fwd per generated token
+    rollout = fwd_tok * (P + mean_new)
+    # experience forwards over the packed sequence:
+    #   shared-backbone PPO: fused old_lp+values pass + ref pass = 2
+    #   GRPO: old_lp pass + ref pass = 2
+    experience = 2 * fwd_tok * seq
+    # update: fwd+bwd per epoch (group trainers update every sample too)
+    update = cfg.num_epochs * 3 * fwd_tok * seq
+    return rollout + experience + update
+
+
+def lower_8b_check() -> str:
+    """AOT-lower the FULL llama3_8b shared-backbone PPO update step
+    (tracing+lowering only — no 8B buffers are allocated).  Returns a
+    short status string for the bench JSON."""
     import jax
     import jax.numpy as jnp
 
-    from orion_tpu.models.transformer import Transformer, init_params
-    from orion_tpu.trainers.grpo import GRPOTrainer
+    from orion_tpu.config import ModelConfig, OptimizerConfig, PPOConfig
+
+    t0 = time.perf_counter()
+    cfg = PPOConfig()
+    cfg.model = ModelConfig.llama3_8b()
+    cfg.model.remat = True
+    cfg.model.scan_layers = True
+    cfg.share_backbone = True
+    cfg.optimizer = OptimizerConfig(
+        learning_rate=1e-6, mu_dtype="bfloat16", nu_dtype="bfloat16")
+    cfg.minibatch_size = 1
+    cfg.rollout.max_prompt_len = 256
+    cfg.rollout.max_new_tokens = 128
+
+    from orion_tpu.models import ActorCriticModel
+    from orion_tpu.trainers.base import TrainState, make_optimizer
+    from orion_tpu.trainers.ppo import PPOTrainer
+
+    model = ActorCriticModel(cfg.model)
+    pshape = jax.eval_shape(
+        lambda k: model.init(k, jnp.zeros((1, 2), jnp.int32),
+                             jnp.zeros((1, 2), jnp.int32))["params"],
+        jax.random.key(0))
+    import flax.linen as nn
+    pshape = jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype),
+        nn.meta.unbox(pshape))
+    tx = make_optimizer(cfg.optimizer)
+    opt_shape = jax.eval_shape(tx.init, pshape)
+    state = TrainState(params=pshape, opt_state=opt_shape,
+                       step=jax.ShapeDtypeStruct((), jnp.int32))
+
+    B, T = cfg.minibatch_size, cfg.rollout.max_new_tokens
+    seq = cfg.rollout.max_prompt_len + T
+    mb = {
+        "sequences": jax.ShapeDtypeStruct((B, seq), jnp.int32),
+        "prompt_lens": jax.ShapeDtypeStruct((B,), jnp.int32),
+        "mask": jax.ShapeDtypeStruct((B, T), jnp.float32),
+        "old_logprobs": jax.ShapeDtypeStruct((B, T), jnp.float32),
+        "old_values": jax.ShapeDtypeStruct((B, T), jnp.float32),
+        "advantages": jax.ShapeDtypeStruct((B, T), jnp.float32),
+        "returns": jax.ShapeDtypeStruct((B, T), jnp.float32),
+    }
+
+    # Unbound-method trick: trace PPOTrainer._update_fn without
+    # building a real 8B trainer (no params are materialized).
+    class _Shell:
+        pass
+
+    shell = _Shell()
+    shell.cfg = cfg
+    shell.model = model
+    shell.tx = tx
+    shell.loss_fn = lambda p, m: PPOTrainer.loss_fn(shell, p, m)
+    shell._lp_values_fwd = \
+        lambda *a, **k: PPOTrainer._lp_values_fwd(shell, *a, **k)
+    shell._gather_completion = PPOTrainer._gather_completion
+
+    from orion_tpu.trainers.base import BaseTrainer
+
+    def update(state, mb):
+        idx = jnp.arange(B)
+        return BaseTrainer._update_fn(shell, state, mb, idx)
+
+    lowered = jax.jit(update).lower(state, mb)
+    del lowered
+    dt = time.perf_counter() - t0
+    return f"ok ({param_count(pshape)/1e9:.2f}B params lowered in {dt:.0f}s)"
+
+
+def main() -> None:
+    import jax
 
     name, cfg = _preset()
-    model = Transformer(cfg.model)
-    params = init_params(model, jax.random.key(0), cfg.model)
-
-    def reward_fn(result, batch):
-        # Rule-style host reward: rewards longer distinct completions.
-        toks = np.asarray(result.completions)
-        return np.asarray(
-            [len(np.unique(t)) for t in toks], np.float32) / toks.shape[1]
-
-    trainer = GRPOTrainer(cfg, model, params, reward_fn=reward_fn,
-                          eos_token_id=1, pad_token_id=0)
+    trainer = build_trainer(name, cfg)
+    n_params = param_count(trainer.state.params)
 
     rs = np.random.RandomState(0)
     B, P = cfg.rollout_batch_size, cfg.rollout.max_prompt_len
@@ -85,21 +234,43 @@ def main() -> None:
             "prompt_lens": np.full((B,), P, np.int32),
         }
 
-    n_samples = B * cfg.group_size
+    group = getattr(cfg, "group_size", 1) if name != "ppo1b" else 1
+    n_samples = B * group
     # Warmup iteration triggers all compiles (prefill, decode loop,
     # logprob recompute, update); measured iterations reuse the cache.
     trainer.train(iter([batch()]), num_iterations=1)
 
     iters = int(os.environ.get("ORION_BENCH_ITERS", "3"))
+    prof_dir = os.environ.get("ORION_BENCH_PROFILE")
+    if prof_dir:
+        jax.profiler.start_trace(prof_dir)
     t0 = time.perf_counter()
-    trainer.train(iter([batch() for _ in range(iters)]),
-                  num_iterations=iters)
+    hist = trainer.train(iter([batch() for _ in range(iters)]),
+                         num_iterations=iters)
     jax.block_until_ready(trainer.state.params)
     dt = time.perf_counter() - t0
+    if prof_dir:
+        jax.profiler.stop_trace()
     value = n_samples * iters / dt
 
+    mean_new = float(np.mean(
+        [h.get("completion_len_mean", cfg.rollout.max_new_tokens)
+         for h in hist[-iters:]]))
+    toks_per_sec = value * mean_new
+    algo = "ppo" if name == "ppo1b" else "grpo"
+    fps = flops_per_sample(n_params, cfg, mean_new)
+    mfu = value * fps / V5E_PEAK_FLOPS if \
+        jax.default_backend() == "tpu" else 0.0
+
+    compile_8b = ""
+    if name == "ppo1b" and os.environ.get("ORION_BENCH_8B", "1") != "0":
+        try:
+            compile_8b = lower_8b_check()
+        except Exception as e:  # report, don't fail the bench
+            compile_8b = f"FAILED: {type(e).__name__}: {e}"
+
     self_path = os.path.join(os.path.dirname(__file__), "BENCH_SELF.json")
-    key = f"grpo_samples_per_sec_{name}"
+    key = f"{algo}_samples_per_sec_{name}"
     base = {}
     if os.path.exists(self_path):
         with open(self_path) as f:
@@ -110,13 +281,19 @@ def main() -> None:
             json.dump(base, f, indent=1)
     vs = value / base[key] if base[key] else 1.0
 
-    print(json.dumps({
-        "metric": f"GRPO samples/sec (rollout+update), preset={name}, "
-                  f"{jax.default_backend()}",
+    out = {
+        "metric": f"{algo.upper()} samples/sec (rollout+update), "
+                  f"preset={name} ({n_params/1e9:.2f}B params, "
+                  f"epochs={cfg.num_epochs}), {jax.default_backend()}",
         "value": round(value, 4),
         "unit": "samples/sec",
         "vs_baseline": round(vs, 4),
-    }))
+        "tokens_per_sec": round(toks_per_sec, 1),
+        "mfu": round(mfu, 4),
+    }
+    if compile_8b:
+        out["compile_8b"] = compile_8b
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

@@ -161,10 +161,14 @@ class OptimizerConfig:
     eps: float = 1e-8
     weight_decay: float = 0.0
     grad_clip: float = 1.0
-    # Adam first-moment dtype (None => param dtype).  bf16 halves the
+    # Adam moment storage dtypes (None => param dtype).  bf16 halves a
     # moment's HBM residency — the difference between a 1B-model RLHF
-    # session (policy+ref+critic+moments) fitting on one 16G chip or not.
+    # session (policy+ref+critic+moments) fitting on one 16G chip or
+    # not.  Setting nu_dtype routes through algos.optim.adamw_lp (the
+    # TPU-native answer to the reference ecosystem's 8-bit Adam); math
+    # stays f32 either way.
     mu_dtype: Optional[str] = None
+    nu_dtype: Optional[str] = None
     warmup_steps: int = 0
     total_steps: int = 0  # 0 => constant lr after warmup
     schedule: str = "constant"  # "constant" | "linear" | "cosine"
@@ -237,6 +241,10 @@ class TrainConfig:
     num_epochs: int = 1
     # KL regularization against the frozen reference policy.
     kl_coef: float = 0.05
+    # Storage dtype for the frozen reference snapshot (None => param
+    # dtype).  The ref only ever runs forward; bf16 halves its HBM
+    # share (2 GB saved at 1B) at the cost of ~1e-3 logprob drift.
+    ref_param_dtype: Optional[str] = None
     adaptive_kl: bool = False
     kl_target: float = 6.0
     kl_horizon: int = 10000
@@ -269,6 +277,11 @@ class PPOConfig(TrainConfig):
     gamma: float = 1.0
     gae_lambda: float = 0.95
     num_epochs: int = 4
+    # Shared policy/value trunk (models.heads.ActorCriticModel): one
+    # backbone pass serves both losses, and the critic costs one
+    # Dense(E,1) instead of a second model+Adam state — how a 1B PPO
+    # session fits a single 16G chip.  False => separate critic model.
+    share_backbone: bool = False
 
 
 @dataclass

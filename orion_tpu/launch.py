@@ -82,16 +82,26 @@ def build_reward(cfg, tokenizer, mesh):
 
 def build_trainer(algo: str, cfg, mesh, tokenizer):
     _, trainer_cls = ALGOS[algo]
-    model = Transformer(cfg.model)
+    shared = algo == "ppo" and cfg.share_backbone
     rng = jax.random.key(cfg.seed)
     host = load_hf_pretrained(cfg.hf_path, cfg.model) if cfg.hf_path else None
+    if shared:
+        from orion_tpu.models.heads import (ActorCriticModel,
+                                            wrap_actor_critic_params)
+
+        model = ActorCriticModel(cfg.model)
+        if host is not None:
+            host = wrap_actor_critic_params(host, cfg.model,
+                                            jax.random.fold_in(rng, 1))
+    else:
+        model = Transformer(cfg.model)
     params, _ = make_sharded_model(model, mesh, rng, _INIT_ARGS,
                                    host_params=host)
     reward_fn = build_reward(cfg, tokenizer, mesh)
     eos = getattr(tokenizer, "eos_token_id", None)
     pad = getattr(tokenizer, "pad_token_id", 0) or 0
     kw = dict(reward_fn=reward_fn, eos_token_id=eos, pad_token_id=pad)
-    if algo == "ppo":
+    if algo == "ppo" and not shared:
         critic = ScalarHeadModel(cfg.model)
         critic_params, _ = make_sharded_model(
             critic, mesh, jax.random.fold_in(rng, 1), _INIT_ARGS)

