@@ -49,6 +49,11 @@ class ModelConfig:
     remat: bool = False  # jax.checkpoint each block (HBM <-> FLOPs trade)
     attention_impl: str = "auto"  # "auto" | "reference" | "flash" | "ring"
     scan_layers: bool = False  # lax.scan over stacked layers (compile-time win)
+    # Megatron-style sequence parallelism: residual-stream activations
+    # between blocks sharded on seq over the TENSOR axis (GSPMD emits
+    # the megatron AG/RS pattern; norms compute on L/tp tokens).  See
+    # parallel.sharding.constrain_seq_activation.
+    seq_shard_activations: bool = False
 
     def __post_init__(self) -> None:
         if self.head_dim == 0:
@@ -111,6 +116,8 @@ class MeshConfig:
       fsdp   — ZeRO-3-style parameter/grad sharding (AG on use, RS on grads)
       tensor — megatron-style tensor parallelism (heads/mlp/vocab)
       seq    — sequence/context parallelism (Ulysses all-to-all, ring attn)
+      stage  — pipeline parallelism (parallel.pipeline: GPipe schedule,
+               ppermute activation ring over ICI)
 
     A size of 1 disables an axis; sizes must multiply to the device count.
     -1 for ``fsdp`` means "all remaining devices".
@@ -120,11 +127,13 @@ class MeshConfig:
     fsdp: int = -1
     tensor: int = 1
     seq: int = 1
-    axis_names: tuple = ("data", "fsdp", "seq", "tensor")
+    stage: int = 1
+    axis_names: tuple = ("stage", "data", "fsdp", "seq", "tensor")
 
     def resolved_shape(self, n_devices: int) -> tuple:
         sizes = {"data": self.data, "fsdp": self.fsdp,
-                 "seq": self.seq, "tensor": self.tensor}
+                 "seq": self.seq, "tensor": self.tensor,
+                 "stage": self.stage}
         fixed = 1
         free = None
         for name, s in sizes.items():
@@ -192,8 +201,16 @@ class RolloutConfig:
     paged: bool = False
     page_size: int = 64
     num_pages: int = 0  # 0 => derived from batch * max_len
-    # Continuous batching: max sequences admitted per engine segment.
+    # Engine selection for the trainer path: "simple" (fixed-batch
+    # RolloutEngine, dense or paged cache) or "continuous" (paged-pool
+    # ContinuousBatchingEngine with slot recycling — wins when
+    # completion lengths are ragged, since freed slots admit new work
+    # instead of idling to the batch max).
+    engine: str = "simple"
+    # Continuous batching: engine slot count (sequences in flight) and
+    # decode tokens per jitted segment.
     max_batch_size: int = 32
+    segment_len: int = 16
     logprobs_dtype: str = "float32"  # f32 softmax to avoid bf16 drift
 
 

@@ -183,17 +183,25 @@ class Block(nn.Module):
     @nn.compact
     def __call__(self, x, positions, layer_cache=None):
         cfg = self.cfg
+        sp = None
+        if cfg.seq_shard_activations:
+            from orion_tpu.parallel.sharding import constrain_seq_activation
+            sp = constrain_seq_activation
+            x = sp(x)
         if cfg.use_parallel_residual:
             # GPT-NeoX: x + attn(ln1(x)) + mlp(ln2(x))
             attn_out, new_cache = Attention(cfg, name="attn")(
                 _norm(cfg, "input_norm")(x), positions, layer_cache)
             mlp_out = MLP(cfg, name="mlp")(_norm(cfg, "post_attn_norm")(x))
-            return x + attn_out + mlp_out, new_cache
+            out = x + attn_out + mlp_out
+            return (sp(out) if sp else out), new_cache
         attn_out, new_cache = Attention(cfg, name="attn")(
             _norm(cfg, "input_norm")(x), positions, layer_cache)
         h = x + attn_out
+        if sp:
+            h = sp(h)
         mlp_out = MLP(cfg, name="mlp")(_norm(cfg, "post_attn_norm")(h))
-        return h + mlp_out, new_cache
+        return (sp(h + mlp_out) if sp else h + mlp_out), new_cache
 
 
 class Transformer(nn.Module):

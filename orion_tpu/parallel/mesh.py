@@ -5,7 +5,7 @@ The TPU-native replacement for the reference's NCCL process groups
 collectives are emitted by XLA from sharding annotations over a
 `jax.sharding.Mesh`; there is no user-space communication library.
 
-Axes: ("data", "fsdp", "seq", "tensor") — see
+Axes: ("stage", "data", "fsdp", "seq", "tensor") — see
 :class:`orion_tpu.config.MeshConfig`.
 """
 

@@ -1,0 +1,235 @@
+"""Pipeline parallelism (SURVEY.md §2 parallelism table, row PP).
+
+TPU-native design — no point-to-point NCCL sends like the reference
+stack's pipelined trainers; instead ONE SPMD program over a ``stage``
+mesh axis:
+
+- each stage holds ``num_layers / n_stages`` transformer blocks as a
+  stacked param subtree (the scan_layers layout re-split stage-major);
+- activations flow stage→stage with ``jax.lax.ppermute`` over the ICI
+  ring inside a ``lax.scan`` over pipeline steps (GPipe schedule:
+  ``n_micro + n_stages - 1`` steps, bubble = (S-1)/(M+S-1));
+- the whole pipeline lives inside ``shard_map``, so ``jax.grad``
+  transposes it automatically into the reverse pipeline (ppermute is
+  linear) — no hand-written backward schedule;
+- embedding / final norm / LM head are replicated across stages and
+  computed redundantly (uniform SPMD beats divergent per-stage code;
+  they are a few % of FLOPs at depth where PP matters).
+
+Composes with the other axes: the stage axis is one more mesh dim, so
+fsdp/tensor shardings apply within each stage unchanged.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from orion_tpu.config import ModelConfig
+
+
+def stack_to_stages(stacked: Any, n_stages: int) -> Any:
+    """Re-split a scan_layers block tree [L, ...] stage-major into
+    [S, L/S, ...]."""
+
+    def split(x):
+        L = x.shape[0]
+        if L % n_stages:
+            raise ValueError(
+                f"num_layers={L} not divisible by n_stages={n_stages}")
+        return x.reshape((n_stages, L // n_stages) + x.shape[1:])
+
+    return jax.tree.map(split, stacked)
+
+
+def stages_to_stack(staged: Any) -> Any:
+    """Inverse of stack_to_stages."""
+    return jax.tree.map(
+        lambda x: x.reshape((x.shape[0] * x.shape[1],) + x.shape[2:]),
+        staged)
+
+
+def _stage_apply(cfg: ModelConfig, stage_params, x, positions):
+    """Run this stage's stacked blocks (lax.scan over the local stack)."""
+    from orion_tpu.models.transformer import Block
+
+    block_cls = Block
+    if cfg.remat:
+        block_cls = nn.remat(Block, static_argnums=())
+    n_local = jax.tree.leaves(stage_params)[0].shape[0]
+    scan_block = nn.scan(
+        block_cls,
+        variable_axes={"params": 0},
+        split_rngs={"params": True},
+        in_axes=(nn.broadcast, nn.broadcast),
+        out_axes=0,
+        length=n_local,
+        metadata_params={nn.meta.PARTITION_NAME: "layers"},
+    )
+    x, _ = scan_block(cfg).apply({"params": stage_params}, x, positions,
+                                 None)
+    return x
+
+
+def pipeline_blocks(cfg: ModelConfig, stage_params, x, positions,
+                    n_microbatches: int, axis: str = "stage"):
+    """GPipe pipeline over the block stack.  MUST run inside shard_map
+    with ``axis`` mapped; ``stage_params`` is the LOCAL stage's stack
+    [L/S, ...]; ``x`` [B, L, E] replicated input activations.
+
+    Returns [B, L, E] final-block activations, replicated (psum of the
+    last stage's collected outputs).
+    """
+    S = jax.lax.axis_size(axis)
+    s = jax.lax.axis_index(axis)
+    M = n_microbatches
+    B = x.shape[0]
+    if B % M:
+        raise ValueError(f"batch {B} not divisible by n_microbatches={M}")
+    mb = B // M
+    mbs = x.reshape((M, mb) + x.shape[1:])
+    pos_mbs = positions.reshape((M, mb) + positions.shape[1:])
+
+    def step(carry, t):
+        recv, outputs = carry
+        # stage 0 injects microbatch t (clamped; garbage past M never
+        # reaches the collected range), others consume the ring.
+        t_c = jnp.clip(t, 0, M - 1)
+        inject = jax.lax.dynamic_index_in_dim(mbs, t_c, keepdims=False)
+        state_in = jnp.where(s == 0, inject, recv)
+        pos_in = jax.lax.dynamic_index_in_dim(pos_mbs, jnp.clip(
+            t - s, 0, M - 1), keepdims=False)
+        state_out = _stage_apply(cfg, stage_params, state_in, pos_in)
+        # collect on the last stage: it finishes microbatch m = t-(S-1)
+        m = t - (S - 1)
+        m_c = jnp.clip(m, 0, M - 1)
+        valid = (m >= 0) & (m < M) & (s == S - 1)
+        cur = jax.lax.dynamic_index_in_dim(outputs, m_c, keepdims=False)
+        outputs = jax.lax.dynamic_update_index_in_dim(
+            outputs, jnp.where(valid, state_out, cur), m_c, 0)
+        send = jax.lax.ppermute(
+            state_out, axis, [(i, (i + 1) % S) for i in range(S)])
+        return (send, outputs), None
+
+    outputs0 = jnp.zeros_like(mbs)
+    recv0 = jnp.zeros_like(mbs[0])
+    (_, outputs), _ = jax.lax.scan(
+        step, (recv0, outputs0), jnp.arange(M + S - 1))
+    # outputs valid only on the last stage -> replicate.
+    outputs = jax.lax.psum(
+        jnp.where(s == S - 1, outputs, jnp.zeros_like(outputs)), axis)
+    return outputs.reshape((B,) + x.shape[1:])
+
+
+class PipelinedTransformer:
+    """Stage-parallel forward for a scan_layers Transformer param tree.
+
+    Usage:
+        pt = PipelinedTransformer(cfg, mesh, n_microbatches=4)
+        staged = pt.shard_params(stacked_params)   # places on the mesh
+        logits = pt.forward(staged, ids, positions)
+
+    ``cfg.scan_layers`` must be True (the stacked layout is the
+    pipeline's param layout; models.hf_loader emits it directly).
+    The embed/final-norm/lm-head subtrees stay replicated; the block
+    stack gains a leading stage axis sharded over the mesh's "stage"
+    dim.  Cited behavior: the reference stack's PP trainer splits the
+    HF module list across ranks and microbatches with NCCL p2p —
+    SURVEY.md §2 marks the mechanism [UNKNOWN]; this is the XLA-native
+    equivalent.
+    """
+
+    def __init__(self, cfg: ModelConfig, mesh: Mesh,
+                 n_microbatches: int = 4, axis: str = "stage"):
+        if not cfg.scan_layers:
+            raise ValueError("pipeline parallelism requires "
+                             "cfg.scan_layers=True (stacked block params)")
+        if axis not in mesh.axis_names:
+            raise ValueError(f"mesh has no {axis!r} axis: {mesh.axis_names}")
+        self.cfg = cfg
+        self.mesh = mesh
+        self.axis = axis
+        self.n_stages = mesh.shape[axis]
+        self.n_microbatches = n_microbatches
+        if cfg.num_layers % self.n_stages:
+            raise ValueError(
+                f"num_layers={cfg.num_layers} not divisible by "
+                f"{self.n_stages} stages")
+
+    # -- param placement ------------------------------------------------
+    def split_params(self, params: Any) -> Any:
+        """Host-side: {'layers': [L,...], rest} ->
+        {'layers': [S, L/S, ...], rest} (no placement)."""
+        out = dict(params)
+        out["layers"] = stack_to_stages(params["layers"], self.n_stages)
+        return out
+
+    def shard_params(self, params: Any) -> Any:
+        """Split + place: block stack sharded over the stage axis,
+        everything else replicated."""
+        staged = self.split_params(params)
+        specs = self.param_specs(staged)
+        from jax.sharding import NamedSharding
+
+        return jax.tree.map(
+            lambda x, sp: jax.device_put(x, NamedSharding(self.mesh, sp)),
+            staged, specs)
+
+    def param_specs(self, staged: Any) -> Any:
+        return {
+            k: jax.tree.map(lambda _: P(self.axis), v) if k == "layers"
+            else jax.tree.map(lambda _: P(), v)
+            for k, v in staged.items()
+        }
+
+    # -- forward --------------------------------------------------------
+    def forward(self, staged_params: Any, ids: jnp.ndarray,
+                positions: jnp.ndarray) -> jnp.ndarray:
+        """Full-model pipelined forward -> f32 logits [B, L, V]."""
+        specs = self.param_specs(staged_params)
+
+        def fn(params, ids, positions):
+            # embed replicated (every stage computes it; only stage 0's
+            # result feeds the pipeline, but uniform SPMD is the point)
+            stage_stack = jax.tree.map(
+                lambda x: jnp.squeeze(x, 0), params["layers"])
+            x = self._embed_apply(params, ids)
+            x = pipeline_blocks(self.cfg, stage_stack, x, positions,
+                                self.n_microbatches, self.axis)
+            return self._head_apply(params, x)
+
+        mapped = shard_map(
+            fn, mesh=self.mesh,
+            in_specs=(specs, P(), P()),
+            out_specs=P(),
+            check_vma=False)
+        return mapped(staged_params, ids, positions)
+
+    # embed / head pieces reuse the Transformer modules so param names
+    # (and HF loading) stay identical to the dense model.
+    def _embed_apply(self, params, ids):
+        cfg = self.cfg
+        from orion_tpu.models.transformer import _dt
+
+        emb = params["embed"]["embedding"]
+        x = jnp.take(emb, ids, axis=0).astype(_dt(cfg.dtype))
+        return x
+
+    def _head_apply(self, params, x):
+        cfg = self.cfg
+        from orion_tpu.models.transformer import _dt, _norm
+
+        norm = _norm(cfg, "final_norm")
+        x = norm.apply({"params": params["final_norm"]}, x)
+        if cfg.tie_word_embeddings:
+            logits = x @ params["embed"]["embedding"].T.astype(
+                _dt(cfg.dtype))
+        else:
+            kernel = params["lm_head"]["kernel"].astype(_dt(cfg.dtype))
+            logits = x @ kernel
+        return logits.astype(jnp.float32)

@@ -71,6 +71,41 @@ def shard_params(params: Any, logical_axes: Any, mesh: Mesh,
     return jax.device_put(params, shardings)
 
 
+def constrain_seq_activation(x):
+    """Megatron-style sequence parallelism (SURVEY.md §2 parallelism
+    table, row SP): constrain a [B, L, E] residual-stream activation to
+    be sharded on L over the TENSOR axis.  With tensor-sharded params,
+    GSPMD then places the all-gather before qkv/up projections and the
+    reduce-scatter after o/down projections — exactly the AG/RS pattern
+    megatron-LM hand-codes — and the norm/residual/dropout region
+    between blocks computes (and stores, under remat) only L/tp of the
+    activations.
+
+    No-ops (returns x) when there is no ambient mesh, the tensor axis
+    is 1, or L is indivisible/degenerate (decode steps) — so it is safe
+    to leave in the model unconditionally behind the config flag.
+    """
+    try:
+        from jax._src import mesh as mesh_lib
+
+        m = mesh_lib.thread_resources.env.physical_mesh
+    except (ImportError, AttributeError):
+        # Private-API guard only (jax moved thread_resources): fall back
+        # to unconstrained rather than breaking every forward — but the
+        # SP tests assert real sharding, so a silent regression here
+        # fails CI loudly.
+        return x
+    if m is None or m.empty:
+        return x
+    tp = dict(m.shape).get("tensor", 1)
+    if tp <= 1 or x.ndim != 3 or x.shape[1] <= 1 or x.shape[1] % tp:
+        return x
+    batch = tuple(a for a in ("data", "fsdp")
+                  if dict(m.shape).get(a, 1) > 1) or None
+    return jax.lax.with_sharding_constraint(
+        x, NamedSharding(m, P(batch, "tensor", None)))
+
+
 def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 

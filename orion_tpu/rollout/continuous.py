@@ -37,8 +37,9 @@ from orion_tpu.runtime import Scheduler
 @dataclasses.dataclass
 class CompletedRequest:
     req_id: int
-    tokens: np.ndarray     # [n] completion token ids
-    logprobs: np.ndarray   # [n] sampling-dist logprobs (f32)
+    tokens: np.ndarray          # [n] completion token ids
+    logprobs: np.ndarray        # [n] sampling-dist logprobs (f32)
+    policy_logprobs: np.ndarray  # [n] raw (untempered) policy logprobs
 
 
 class ContinuousBatchingEngine:
@@ -46,13 +47,14 @@ class ContinuousBatchingEngine:
 
     def __init__(self, model, model_cfg: ModelConfig, cfg: RolloutConfig,
                  eos_token_id: Optional[int] = None, pad_token_id: int = 0,
-                 segment_len: int = 16):
+                 segment_len: Optional[int] = None):
         self.model = model
         self.mc = model_cfg
         self.cfg = cfg
         self.eos = eos_token_id
         self.pad = pad_token_id
-        self.segment_len = segment_len
+        self.segment_len = (cfg.segment_len if segment_len is None
+                            else segment_len)
         self.slots = cfg.max_batch_size
         ps = cfg.page_size
         self.pages_per_seq = -(-(cfg.max_prompt_len + cfg.max_new_tokens)
@@ -79,10 +81,35 @@ class ContinuousBatchingEngine:
                            for _ in range(model_cfg.num_layers)]
         self._bt = np.full((self.slots, self.pages_per_seq), self._scratch,
                            np.int32)
+        self._params = None
 
         self._jit_prefill = jax.jit(self._prefill_fn, donate_argnums=(1,))
         self._jit_segment = jax.jit(self._segment_fn, donate_argnums=(1,),
                                     static_argnames=("n_steps",))
+
+    # -- weight hot-reload channel (trainer → rollout) ------------------
+    def _compute_cast(self, params):
+        cdt = jnp.dtype(self.mc.dtype)
+        if cdt == jnp.dtype(self.mc.param_dtype):
+            return params
+        return jax.tree.map(
+            lambda x: x.astype(cdt)
+            if jnp.issubdtype(x.dtype, jnp.floating) else x, params)
+
+    def load_weights(self, params) -> None:
+        """Install policy weights (same contract as RolloutEngine):
+        the f32 master tree is cast to the compute dtype ONCE here, so
+        every decode step reads 2 bytes/param instead of 4."""
+        self._params = self._compute_cast(params)
+
+    @staticmethod
+    def _bucket(n: int, cap: int) -> int:
+        """Next power-of-2 ≥ n (≤ cap): bounds prefill recompiles to
+        log2(slots) programs while wasting <2x compute on odd waves."""
+        b = 1
+        while b < n:
+            b *= 2
+        return min(b, cap)
 
     # -- jitted programs ------------------------------------------------
     def _cache(self, pools, bt):
@@ -102,20 +129,23 @@ class ContinuousBatchingEngine:
         return [{"k_pages": c["k_pages"], "v_pages": c["v_pages"]}
                 for c in cache]
 
-    def _prefill_fn(self, params, pools, bt_row, prompt_ids, prompt_len,
+    def _prefill_fn(self, params, pools, bt_rows, prompt_ids, prompt_lens,
                     rng):
-        """One admitted request: fill its pages, sample token 0.
+        """One admission WAVE: fill pages for all admitted requests in a
+        single jitted program (the r1 per-request serial prefill was the
+        opposite of what continuous batching is for — VERDICT weak #5).
 
-        prompt_ids [1, Pmax] right-padded; bt_row [1, pages_per_seq].
-        Returns (pools, tok0 [1], lp0 [1], plp0 [1]).
+        prompt_ids [B, Pmax] right-padded; bt_rows [B, pages_per_seq]
+        (pad rows point wholly at the scratch page).
+        Returns (pools, tok0 [B], lp0 [B], plp0 [B]).
         """
-        P = prompt_ids.shape[1]
-        positions = jnp.arange(P, dtype=jnp.int32)[None, :]
-        cache = self._cache(pools, bt_row)
+        B, P = prompt_ids.shape
+        positions = jnp.broadcast_to(jnp.arange(P, dtype=jnp.int32), (B, P))
+        cache = self._cache(pools, bt_rows)
         logits, cache = self.model.apply({"params": params}, prompt_ids,
                                          positions, cache)
         last = jnp.take_along_axis(
-            logits, (prompt_len - 1)[:, None, None], axis=1)[:, 0]
+            logits, (prompt_lens - 1)[:, None, None], axis=1)[:, 0]
         tok0, lp0, plp0 = sample_tokens(
             rng, last, temperature=self.cfg.temperature,
             top_k=self.cfg.top_k, top_p=self.cfg.top_p)
@@ -167,11 +197,15 @@ class ContinuousBatchingEngine:
 
     # -- host driver ----------------------------------------------------
     def generate(self, requests: Iterable[Tuple[int, np.ndarray]],
-                 rng: jax.Array, params) -> List[CompletedRequest]:
+                 rng: jax.Array, params=None) -> List[CompletedRequest]:
         """Run all requests to completion; returns them in finish order.
 
         requests: iterable of (req_id, prompt_ids 1-D int array).
         """
+        params = (self._compute_cast(params) if params is not None
+                  else self._params)
+        if params is None:
+            raise ValueError("no weights loaded: call load_weights() first")
         cfg = self.cfg
         S = self.slots
         requests = list(requests)  # may be a generator; we iterate twice
@@ -201,33 +235,51 @@ class ContinuousBatchingEngine:
                     f"{self.sched.waiting} request(s) can never be "
                     f"scheduled: pool of {self.num_pages} pages is too "
                     "small for a single request's reservation")
-            for req_id, slot in admitted:
-                pages = self.sched.pages(req_id)
-                self._bt[slot, : len(pages)] = pages
-                # Unreserved tail → scratch page: prefill writes KV for
-                # every padded prompt position, and a short-reservation
-                # request (prompt_len + max_new < max_prompt_len) would
-                # otherwise wrap pad-position writes onto its *last real
-                # page*, clobbering prompt KV (ADVICE r1 high).
-                self._bt[slot, len(pages):] = self._scratch
-                ids = prompts[req_id]
+            if admitted:
+                # Batched admission prefill: ONE jitted call per wave,
+                # padded to a power-of-2 bucket (≤ slots) so at most
+                # log2(slots) programs ever compile.
                 P = cfg.max_prompt_len
-                row = np.full((1, P), self.pad, np.int32)
-                row[0, : len(ids)] = ids
+                nb = self._bucket(len(admitted), S)
+                rows = np.full((nb, P), self.pad, np.int32)
+                lens_w = np.ones((nb,), np.int32)
+                bt_w = np.full((nb, self.pages_per_seq), self._scratch,
+                               np.int32)
+                for j, (req_id, slot) in enumerate(admitted):
+                    pages = self.sched.pages(req_id)
+                    self._bt[slot, : len(pages)] = pages
+                    # Unreserved tail → scratch page: prefill writes KV
+                    # for every padded prompt position, and a
+                    # short-reservation request (prompt_len + max_new <
+                    # max_prompt_len) would otherwise wrap pad-position
+                    # writes onto its *last real page*, clobbering
+                    # prompt KV (ADVICE r1 high).
+                    self._bt[slot, len(pages):] = self._scratch
+                    ids = prompts[req_id]
+                    rows[j, : len(ids)] = ids
+                    lens_w[j] = len(ids)
+                    bt_w[j] = self._bt[slot]
                 rng, sub = jax.random.split(rng)
                 pools, tok0, lp0, plp0 = self._jit_prefill(
-                    params, pools, jnp.asarray(self._bt[slot:slot + 1]),
-                    jnp.asarray(row), jnp.asarray([len(ids)], jnp.int32),
-                    sub)
-                slot_req[slot] = req_id
-                n_new[slot] = 1
-                collected[req_id] = [(int(tok0[0]), float(lp0[0]),
-                                      float(plp0[0]))]
-                cur_tok = cur_tok.at[slot].set(tok0[0])
-                lengths = lengths.at[slot].set(len(ids))
-                d0 = bool(tok0[0] == self.eos) if self.eos is not None \
-                    else False
-                done = done.at[slot].set(d0)
+                    params, pools, jnp.asarray(bt_w), jnp.asarray(rows),
+                    jnp.asarray(lens_w), sub)
+                tok0_h = np.asarray(tok0)
+                lp0_h = np.asarray(lp0)
+                plp0_h = np.asarray(plp0)
+                slot_idx = np.asarray([s for _, s in admitted], np.int64)
+                cur_tok = cur_tok.at[jnp.asarray(slot_idx)].set(
+                    jnp.asarray(tok0_h[: len(admitted)]))
+                lengths = lengths.at[jnp.asarray(slot_idx)].set(
+                    jnp.asarray(lens_w[: len(admitted)]))
+                d0 = (tok0_h[: len(admitted)] == self.eos) \
+                    if self.eos is not None else \
+                    np.zeros(len(admitted), bool)
+                done = done.at[jnp.asarray(slot_idx)].set(jnp.asarray(d0))
+                for j, (req_id, slot) in enumerate(admitted):
+                    slot_req[slot] = req_id
+                    n_new[slot] = 1
+                    collected[req_id] = [(int(tok0_h[j]), float(lp0_h[j]),
+                                          float(plp0_h[j]))]
 
             # -- decode segment ----------------------------------------
             if not bool(jnp.all(done)):
@@ -275,7 +327,9 @@ class ContinuousBatchingEngine:
                         req_id=int(req_id),
                         tokens=np.asarray([x[0] for x in seq], np.int32),
                         logprobs=np.asarray([x[1] for x in seq],
-                                            np.float32)))
+                                            np.float32),
+                        policy_logprobs=np.asarray([x[2] for x in seq],
+                                                   np.float32)))
                     self.sched.finish(int(req_id))
                     slot_req[s] = -1
                     n_new[s] = 0
@@ -284,3 +338,49 @@ class ContinuousBatchingEngine:
 
         self._pools = pools
         return out
+
+    # -- trainer-facing batch API (GenerationResult contract) -----------
+    def generate_batch(self, prompt_ids, prompt_lens, rng: jax.Array,
+                       params=None, max_new_tokens: Optional[int] = None):
+        """RolloutEngine-compatible surface (VERDICT r1 next #5): run the
+        batch as a request stream through the continuous scheduler and
+        pack the completions into a padded GenerationResult — so any
+        trainer can select this engine via RolloutConfig.engine.
+
+        max_new_tokens, if given, must equal cfg.max_new_tokens (the
+        page reservations are sized for it)."""
+        from orion_tpu.ops.logprobs import pack_sequences
+        from orion_tpu.rollout.engine import GenerationResult
+
+        if max_new_tokens is not None and \
+                max_new_tokens != self.cfg.max_new_tokens:
+            raise ValueError(
+                f"continuous engine reserves pages for max_new_tokens="
+                f"{self.cfg.max_new_tokens}; got {max_new_tokens}")
+        prompt_ids = np.asarray(prompt_ids)
+        prompt_lens = np.asarray(prompt_lens, np.int32)
+        B = prompt_ids.shape[0]
+        T = self.cfg.max_new_tokens
+        reqs = [(i, prompt_ids[i, : prompt_lens[i]]) for i in range(B)]
+        by_id = {r.req_id: r for r in self.generate(reqs, rng, params)}
+
+        tokens = np.full((B, T), self.pad, np.int32)
+        logps = np.zeros((B, T), np.float32)
+        plogps = np.zeros((B, T), np.float32)
+        comp_len = np.zeros((B,), np.int32)
+        for i in range(B):
+            r = by_id[i]
+            n = len(r.tokens)
+            tokens[i, :n] = r.tokens
+            logps[i, :n] = r.logprobs
+            plogps[i, :n] = r.policy_logprobs
+            comp_len[i] = n
+        mask = (np.arange(T)[None, :] < comp_len[:, None]).astype(np.float32)
+        sequences = np.asarray(pack_sequences(
+            jnp.asarray(prompt_ids), jnp.asarray(prompt_lens),
+            jnp.asarray(tokens)))
+        return GenerationResult(
+            sequences=sequences, completions=tokens,
+            completion_mask=mask, completion_lens=comp_len,
+            logprobs=logps, policy_logprobs=plogps,
+            prompt_lens=prompt_lens, total_lens=prompt_lens + comp_len)

@@ -36,8 +36,37 @@ class TrainState:
 
     @staticmethod
     def create(params: Any, tx: optax.GradientTransformation) -> "TrainState":
-        return TrainState(params=params, opt_state=tx.init(params),
-                          step=jnp.zeros((), jnp.int32))
+        state = TrainState(params=params, opt_state=tx.init(params),
+                           step=jnp.zeros((), jnp.int32))
+        return _commit_to_params_mesh(state)
+
+
+def _commit_to_params_mesh(state: "TrainState") -> "TrainState":
+    """Pin every TrainState leaf to the params' mesh (scalars/counters
+    replicated).  optax.init creates its counters eagerly on the default
+    device as UNcommitted arrays; jit tolerates that, but an orbax
+    restore brings them back COMMITTED there, and a committed cpu:0
+    counter next to mesh-committed params is a cross-device jit error —
+    the elastic-resume failure mode (SURVEY.md §5 failure recovery)."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    mesh = None
+    for x in jax.tree.leaves(state.params):
+        sh = getattr(x, "sharding", None)
+        if isinstance(sh, NamedSharding):
+            mesh = sh.mesh
+            break
+    if mesh is None:
+        return state
+    repl = NamedSharding(mesh, PartitionSpec())
+
+    def fix(x):
+        sh = getattr(x, "sharding", None)
+        if isinstance(sh, NamedSharding) and sh.mesh == mesh:
+            return x
+        return jax.device_put(x, repl)
+
+    return jax.tree.map(fix, state)
 
 
 def make_schedule(cfg: OptimizerConfig):
@@ -125,9 +154,21 @@ class BaseTrainer:
                 self.ref_params = jax.tree.map(jnp.copy, params)
         else:
             self.ref_params = None
-        self.engine = RolloutEngine(model, cfg.model, cfg.rollout,
-                                    eos_token_id=eos_token_id,
-                                    pad_token_id=pad_token_id)
+        if cfg.rollout.engine == "continuous":
+            from orion_tpu.rollout.continuous import ContinuousBatchingEngine
+
+            self.engine = ContinuousBatchingEngine(
+                model, cfg.model, cfg.rollout, eos_token_id=eos_token_id,
+                pad_token_id=pad_token_id,
+                segment_len=cfg.rollout.segment_len)
+        elif cfg.rollout.engine == "simple":
+            self.engine = RolloutEngine(model, cfg.model, cfg.rollout,
+                                        eos_token_id=eos_token_id,
+                                        pad_token_id=pad_token_id)
+        else:
+            raise ValueError(
+                f"rollout.engine must be 'simple' or 'continuous', "
+                f"got {cfg.rollout.engine!r}")
         self.engine.load_weights(params)
         self.metrics_history: list = []
         self._rng = jax.random.key(cfg.seed)
@@ -187,6 +228,14 @@ class BaseTrainer:
         return sub
 
     def generate(self, prompt_ids, prompt_lens) -> GenerationResult:
+        if hasattr(self.engine, "generate_batch"):
+            # Continuous engine: host-driven admission loop; it takes
+            # host prompt arrays directly.  params=None -> the engine
+            # uses the compute-dtype copy installed by sync_weights /
+            # construction (an explicit tree here would be re-cast every
+            # iteration for nothing).
+            return self.engine.generate_batch(
+                prompt_ids, prompt_lens, self.next_rng())
         # One batched host→device transfer for both prompt arrays.
         ids, lens = jax.device_put((np.asarray(prompt_ids),
                                     np.asarray(prompt_lens)))

@@ -1,0 +1,109 @@
+"""Fault injection / elastic recovery (SURVEY.md §5 "Failure detection":
+kill the rollout group mid-step; the learner must surface the failure
+promptly, keep its completed work, and a rebuilt session must resume
+from the checkpoint and finish the run)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from orion_tpu.config import GRPOConfig, MeshConfig
+from orion_tpu.models import Transformer, init_params
+from orion_tpu.models.sharded import make_sharded_model
+from orion_tpu.orchestration import AsyncOrchestrator, split_devices
+from orion_tpu.parallel.mesh import make_mesh
+from orion_tpu.trainers import GRPOTrainer
+
+from test_trainers import lucky_token_reward, prompt_stream, _mk
+
+
+class KillSwitch(Exception):
+    pass
+
+
+def _build(tmp_path, seed=0):
+    cfg = _mk(GRPOConfig, group_size=4, kl_coef=0.0, num_epochs=1,
+              async_mode=True, async_staleness=1, seed=seed,
+              checkpoint_dir=str(tmp_path / "ckpt"), checkpoint_every=2)
+    rollout_devs, train_devs = split_devices(jax.devices(), 4)
+    train_mesh = make_mesh(MeshConfig(data=1, fsdp=-1, seq=1, tensor=1),
+                           devices=train_devs)
+    model = Transformer(cfg.model)
+    init_args = (jnp.zeros((1, 2), jnp.int32), jnp.zeros((1, 2), jnp.int32))
+    params, _ = make_sharded_model(model, train_mesh, jax.random.key(0),
+                                   init_args)
+    trainer = GRPOTrainer(cfg, model, params,
+                          reward_fn=lucky_token_reward, eos_token_id=None)
+    orch = AsyncOrchestrator(trainer, rollout_devs)
+    return cfg, trainer, orch
+
+
+def _arm_kill(orch, after_batches: int):
+    """Kill the rollout group: its generate dispatch dies mid-run."""
+    real = orch.engine.generate
+    calls = {"n": 0}
+
+    def dying(*a, **kw):
+        calls["n"] += 1
+        if calls["n"] > after_batches:
+            raise KillSwitch(f"rollout group killed at batch {calls['n']}")
+        return real(*a, **kw)
+
+    orch.engine.generate = dying
+    return calls
+
+
+def test_learner_surfaces_rollout_death(tmp_path):
+    cfg, trainer, orch = _build(tmp_path)
+    _arm_kill(orch, after_batches=3)
+    with pytest.raises(RuntimeError, match="rollout worker died") as ei:
+        orch.train(prompt_stream(2, 4), num_iterations=8)
+    assert isinstance(ei.value.__cause__, KillSwitch)
+    # completed iterations' metrics survived; no hang (the raise IS the
+    # promptness assertion — the learner drained instead of blocking on
+    # the dead queue forever)
+    assert 1 <= len(trainer.metrics_history) <= 3
+    for h in trainer.metrics_history:
+        assert np.isfinite(h["loss"])
+
+
+def test_resume_after_rollout_death_completes_run(tmp_path):
+    """The full elastic story: crash at batch 4 (after the step-2
+    checkpoint), rebuild the session, resume, finish — final state has
+    the full iteration count and bounded staleness throughout."""
+    cfg, trainer, orch = _build(tmp_path)
+    _arm_kill(orch, after_batches=4)
+    with pytest.raises(RuntimeError, match="rollout worker died"):
+        orch.train(prompt_stream(2, 4), num_iterations=8)
+    trainer.ckpt.wait()
+    assert trainer.ckpt.latest_step() is not None
+
+    # fresh process equivalent: rebuild everything, restore, continue
+    cfg2, trainer2, orch2 = _build(tmp_path, seed=0)
+    it = prompt_stream(2, 4)
+    assert trainer2.resume(it)
+    start = trainer2.global_iter
+    assert start >= 2  # the step-2 checkpoint (or later) was restored
+    history = orch2.train(it, num_iterations=8 - start)
+    assert trainer2.global_iter == 8
+    for h in history:
+        assert np.isfinite(h["loss"])
+        assert 0 <= h["staleness"] <= cfg2.async_staleness
+
+
+def test_orchestrator_reusable_after_crash(tmp_path):
+    """A crashed orchestrator instance can be retrained directly (the
+    in-place recovery path): train() resets the stop flag, drains the
+    queue, and the next run completes."""
+    cfg, trainer, orch = _build(tmp_path)
+    calls = _arm_kill(orch, after_batches=2)
+    with pytest.raises(RuntimeError, match="rollout worker died"):
+        orch.train(prompt_stream(2, 4), num_iterations=6)
+    done_before = len(trainer.metrics_history)
+    # heal the engine and go again
+    calls["n"] = -(10 ** 9)
+    history = orch.train(prompt_stream(2, 4), num_iterations=3)
+    assert len(history) == done_before + 3
+    for h in history[done_before:]:
+        assert np.isfinite(h["loss"])

@@ -1,0 +1,103 @@
+"""Megatron-style sequence parallelism (SURVEY.md §2 parallelism table,
+row SP): residual-stream activations sharded on seq over the tensor
+axis.  8-fake-CPU-device harness; numerics must match the unconstrained
+model exactly (a sharding constraint changes layout, not math)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from orion_tpu.config import MeshConfig, ModelConfig
+from orion_tpu.models import Transformer, init_params
+from orion_tpu.models.sharded import make_sharded_model
+from orion_tpu.parallel.mesh import make_mesh
+from orion_tpu.parallel.sharding import constrain_seq_activation
+
+
+def _cfg(**kw):
+    return ModelConfig.tiny(
+        vocab_size=64, hidden_size=32, intermediate_size=64,
+        num_layers=2, num_heads=4, num_kv_heads=4, dtype="float32", **kw)
+
+
+def test_constraint_shards_seq_over_tensor():
+    mesh = make_mesh(MeshConfig(data=1, fsdp=2, seq=1, tensor=4),
+                     jax.devices()[:8])
+    x = jnp.ones((2, 8, 32), jnp.float32)
+    with mesh:
+        y = jax.jit(constrain_seq_activation)(x)
+    assert y.sharding.spec[1] == "tensor", y.sharding
+    np.testing.assert_array_equal(np.asarray(y), np.asarray(x))
+
+
+def test_constraint_noops_safely():
+    # no mesh
+    x = jnp.ones((2, 8, 32), jnp.float32)
+    np.testing.assert_array_equal(
+        np.asarray(constrain_seq_activation(x)), np.asarray(x))
+    # tensor axis of 1
+    mesh = make_mesh(MeshConfig(data=1, fsdp=-1, tensor=1),
+                     jax.devices()[:8])
+    with mesh:
+        y = jax.jit(constrain_seq_activation)(x)
+    np.testing.assert_array_equal(np.asarray(y), np.asarray(x))
+    # decode step (L=1) and indivisible L
+    mesh = make_mesh(MeshConfig(data=1, fsdp=2, tensor=4),
+                     jax.devices()[:8])
+    with mesh:
+        y1 = jax.jit(constrain_seq_activation)(jnp.ones((2, 1, 32)))
+        y2 = jax.jit(constrain_seq_activation)(jnp.ones((2, 7, 32)))
+    assert y1.shape == (2, 1, 32) and y2.shape == (2, 7, 32)
+
+
+def test_sp_model_matches_dense():
+    """TP mesh + seq_shard_activations: logits equal the unconstrained
+    sharded model (same params)."""
+    mesh = make_mesh(MeshConfig(data=1, fsdp=2, seq=1, tensor=4),
+                     jax.devices()[:8])
+    cfg = _cfg()
+    cfg_sp = _cfg(seq_shard_activations=True)
+    model = Transformer(cfg)
+    model_sp = Transformer(cfg_sp)
+    with mesh:
+        params, _ = make_sharded_model(
+            model, mesh, jax.random.key(0),
+            (jnp.zeros((1, 2), jnp.int32), jnp.zeros((1, 2), jnp.int32)))
+        ids = jax.random.randint(jax.random.key(1), (4, 16), 1, 64)
+        pos = jnp.broadcast_to(jnp.arange(16, dtype=jnp.int32), (4, 16))
+        lg, _ = jax.jit(
+            lambda p, i, q: model.apply({"params": p}, i, q))(
+                params, ids, pos)
+        lg_sp, _ = jax.jit(
+            lambda p, i, q: model_sp.apply({"params": p}, i, q))(
+                params, ids, pos)
+    np.testing.assert_allclose(np.asarray(lg_sp), np.asarray(lg),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_sp_grads_match_dense():
+    mesh = make_mesh(MeshConfig(data=1, fsdp=1, seq=1, tensor=8),
+                     jax.devices()[:8])
+    cfg = _cfg()
+    cfg_sp = _cfg(seq_shard_activations=True)
+    model = Transformer(cfg)
+    model_sp = Transformer(cfg_sp)
+    with mesh:
+        params, _ = make_sharded_model(
+            model, mesh, jax.random.key(0),
+            (jnp.zeros((1, 2), jnp.int32), jnp.zeros((1, 2), jnp.int32)))
+        ids = jax.random.randint(jax.random.key(1), (2, 16), 1, 64)
+        pos = jnp.broadcast_to(jnp.arange(16, dtype=jnp.int32), (2, 16))
+
+        def loss(m):
+            def f(p):
+                lg, _ = m.apply({"params": p}, ids, pos)
+                return jnp.mean(jax.nn.logsumexp(lg, axis=-1))
+            return f
+
+        g = jax.jit(jax.grad(loss(model)))(params)
+        g_sp = jax.jit(jax.grad(loss(model_sp)))(params)
+    for a, b in zip(jax.tree.leaves(g_sp), jax.tree.leaves(g)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-6)

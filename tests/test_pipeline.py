@@ -1,0 +1,111 @@
+"""Pipeline parallelism (parallel.pipeline): GPipe schedule over a
+"stage" mesh axis on the 8-fake-CPU-device harness (SURVEY.md §4).
+Forward AND gradients must match the dense scan_layers model."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from orion_tpu.config import MeshConfig, ModelConfig
+from orion_tpu.models import Transformer, init_params
+from orion_tpu.parallel.mesh import make_mesh
+from orion_tpu.parallel.pipeline import (PipelinedTransformer,
+                                         stack_to_stages, stages_to_stack)
+
+
+def _cfg(layers=4):
+    return ModelConfig.tiny(
+        vocab_size=64, hidden_size=32, intermediate_size=64,
+        num_layers=layers, num_heads=2, num_kv_heads=2,
+        dtype="float32", scan_layers=True)
+
+
+def _setup(n_stages, layers=4, n_micro=2, B=4, L=16):
+    cfg = _cfg(layers)
+    model = Transformer(cfg)
+    params = init_params(model, jax.random.key(0), cfg)
+    mesh = make_mesh(MeshConfig(stage=n_stages, data=1, fsdp=-1,
+                                seq=1, tensor=1), jax.devices()[:8])
+    pt = PipelinedTransformer(cfg, mesh, n_microbatches=n_micro)
+    staged = pt.shard_params(params)
+    ids = jax.random.randint(jax.random.key(1), (B, L), 1, cfg.vocab_size)
+    pos = jnp.broadcast_to(jnp.arange(L, dtype=jnp.int32), (B, L))
+    return cfg, model, params, pt, staged, ids, pos
+
+
+def test_stage_split_roundtrip():
+    cfg = _cfg(4)
+    params = init_params(Transformer(cfg), jax.random.key(0), cfg)
+    staged = stack_to_stages(params["layers"], 2)
+    leaf = jax.tree.leaves(staged)[0]
+    assert leaf.shape[0] == 2
+    back = stages_to_stack(staged)
+    for a, b in zip(jax.tree.leaves(back),
+                    jax.tree.leaves(params["layers"])):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+@pytest.mark.parametrize("n_stages,n_micro", [(2, 2), (4, 3), (8, 4)])
+def test_pipelined_forward_matches_dense(n_stages, n_micro):
+    B = 6 if n_micro == 3 else 4
+    cfg, model, params, pt, staged, ids, pos = _setup(
+        n_stages, layers=8, n_micro=n_micro, B=B)
+    dense_logits, _ = jax.jit(
+        lambda p, i, q: model.apply({"params": p}, i, q))(params, ids, pos)
+    pp_logits = jax.jit(pt.forward)(staged, ids, pos)
+    np.testing.assert_allclose(np.asarray(pp_logits),
+                               np.asarray(dense_logits),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_pipelined_grad_matches_dense():
+    """The reverse pipeline comes from AD transposing the ppermute scan
+    — gradients must equal the dense model's."""
+    cfg, model, params, pt, staged, ids, pos = _setup(2, layers=4,
+                                                      n_micro=2)
+    tgt = jax.random.normal(jax.random.key(2), (4, 16))
+
+    def dense_loss(p):
+        lg, _ = model.apply({"params": p}, ids, pos)
+        lp = jax.nn.log_softmax(lg, axis=-1)
+        return -jnp.mean(jnp.take_along_axis(
+            lp, (ids % cfg.vocab_size)[..., None], axis=-1)) + \
+            0.0 * jnp.sum(tgt)
+
+    def pp_loss(sp):
+        lg = pt.forward(sp, ids, pos)
+        lp = jax.nn.log_softmax(lg, axis=-1)
+        return -jnp.mean(jnp.take_along_axis(
+            lp, (ids % cfg.vocab_size)[..., None], axis=-1)) + \
+            0.0 * jnp.sum(tgt)
+
+    g_dense = jax.grad(dense_loss)(params)
+    g_pp = jax.grad(pp_loss)(staged)
+    # compare the block-stack grads (restacked) and the replicated parts
+    g_pp_layers = stages_to_stack(g_pp["layers"])
+    for a, b in zip(jax.tree.leaves(g_pp_layers),
+                    jax.tree.leaves(g_dense["layers"])):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-5)
+    for key in ("embed", "final_norm", "lm_head"):
+        for a, b in zip(jax.tree.leaves(g_pp[key]),
+                        jax.tree.leaves(g_dense[key])):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=2e-4, atol=2e-5,
+                                       err_msg=key)
+
+
+def test_pipeline_requires_scan_layers():
+    cfg = _cfg(4)
+    cfg.scan_layers = False
+    mesh = make_mesh(MeshConfig(stage=2, fsdp=-1), jax.devices()[:8])
+    with pytest.raises(ValueError, match="scan_layers"):
+        PipelinedTransformer(cfg, mesh)
+
+
+def test_pipeline_rejects_indivisible_layers():
+    cfg = _cfg(4)
+    mesh = make_mesh(MeshConfig(stage=8, fsdp=-1), jax.devices()[:8])
+    with pytest.raises(ValueError, match="divisible"):
+        PipelinedTransformer(cfg, mesh)
