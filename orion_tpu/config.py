@@ -54,6 +54,15 @@ class ModelConfig:
     # the megatron AG/RS pattern; norms compute on L/tp tokens).  See
     # parallel.sharding.constrain_seq_activation.
     seq_shard_activations: bool = False
+    # Mixture-of-Experts (ops.moe): 0 = dense MLP; > 0 replaces every
+    # block's MLP with a top-2-routed expert bank of this size, stacked
+    # on the "expert" logical axis (expert parallelism over the mesh's
+    # ``expert`` dim).  capacity_factor bounds tokens/expert (GShard).
+    num_experts: int = 0
+    expert_capacity_factor: float = 1.25
+    # Weight of the Switch load-balance auxiliary loss (consumed by the
+    # trainer loss paths via BaseTrainer._logprobs_fn's aux output).
+    router_aux_coef: float = 0.01
 
     def __post_init__(self) -> None:
         if self.head_dim == 0:
@@ -118,6 +127,8 @@ class MeshConfig:
       seq    — sequence/context parallelism (Ulysses all-to-all, ring attn)
       stage  — pipeline parallelism (parallel.pipeline: GPipe schedule,
                ppermute activation ring over ICI)
+      expert — expert parallelism (ops.moe: expert-stacked params
+               sharded; dispatch/combine einsums become EP collectives)
 
     A size of 1 disables an axis; sizes must multiply to the device count.
     -1 for ``fsdp`` means "all remaining devices".
@@ -128,12 +139,14 @@ class MeshConfig:
     tensor: int = 1
     seq: int = 1
     stage: int = 1
-    axis_names: tuple = ("stage", "data", "fsdp", "seq", "tensor")
+    expert: int = 1
+    axis_names: tuple = ("stage", "data", "fsdp", "seq", "expert",
+                         "tensor")
 
     def resolved_shape(self, n_devices: int) -> tuple:
         sizes = {"data": self.data, "fsdp": self.fsdp,
                  "seq": self.seq, "tensor": self.tensor,
-                 "stage": self.stage}
+                 "stage": self.stage, "expert": self.expert}
         fixed = 1
         free = None
         for name, s in sizes.items():

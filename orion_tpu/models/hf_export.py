@@ -32,6 +32,10 @@ def _w(lin: Dict[str, Any]) -> np.ndarray:
 
 def hf_state_dict(params: dict, cfg: ModelConfig) -> Dict[str, np.ndarray]:
     """Convert a policy param tree to the HF naming/layout."""
+    if cfg.num_experts > 0:
+        raise ValueError(
+            "HF export of MoE models is not supported: the expert-"
+            "stacked MLP (ops.moe) has no llama/neox HF layout")
     params = dict(params)
     if "backbone" in params:  # ActorCriticModel / ScalarHeadModel tree
         params = dict(params["backbone"])

@@ -74,15 +74,15 @@ def stack_layer_params(p: dict, num_layers: int) -> dict:
 
 
 def unstack_layer_params(p: dict, num_layers: int) -> dict:
-    """Inverse of :func:`stack_layer_params` (HF export path).  Returns
-    a new top-level dict; the input is not mutated."""
+    """Inverse of :func:`stack_layer_params` (HF export path), as host
+    numpy.  Thin wrapper over the jit-safe
+    models.transformer.unstack_params_tree (single source of truth for
+    the stacked-layers inverse)."""
     import jax
 
-    p = dict(p)
-    stacked = p.pop("layers")
-    for i in range(num_layers):
-        p[f"layers_{i}"] = jax.tree.map(lambda x: np.asarray(x[i]), stacked)
-    return p
+    from orion_tpu.models.transformer import unstack_params_tree
+
+    return jax.tree.map(np.asarray, unstack_params_tree(p, num_layers))
 
 
 def _convert_llama(sd: Mapping[str, Any], cfg: ModelConfig) -> dict:

@@ -188,11 +188,17 @@ class Block(nn.Module):
             from orion_tpu.parallel.sharding import constrain_seq_activation
             sp = constrain_seq_activation
             x = sp(x)
+        if cfg.num_experts > 0:
+            from orion_tpu.ops.moe import MoEMLP
+            mlp_cls = MoEMLP
+        else:
+            mlp_cls = MLP
         if cfg.use_parallel_residual:
             # GPT-NeoX: x + attn(ln1(x)) + mlp(ln2(x))
             attn_out, new_cache = Attention(cfg, name="attn")(
                 _norm(cfg, "input_norm")(x), positions, layer_cache)
-            mlp_out = MLP(cfg, name="mlp")(_norm(cfg, "post_attn_norm")(x))
+            mlp_out = mlp_cls(cfg, name="mlp")(
+                _norm(cfg, "post_attn_norm")(x))
             out = x + attn_out + mlp_out
             return (sp(out) if sp else out), new_cache
         attn_out, new_cache = Attention(cfg, name="attn")(
@@ -200,7 +206,7 @@ class Block(nn.Module):
         h = x + attn_out
         if sp:
             h = sp(h)
-        mlp_out = MLP(cfg, name="mlp")(_norm(cfg, "post_attn_norm")(h))
+        mlp_out = mlp_cls(cfg, name="mlp")(_norm(cfg, "post_attn_norm")(h))
         return (sp(h + mlp_out) if sp else h + mlp_out), new_cache
 
 
@@ -296,6 +302,28 @@ def init_cache(cfg: ModelConfig, batch: int, max_len: int,
         return {"k": jnp.zeros(stacked, dtype), "v": jnp.zeros(stacked, dtype)}
     return [{"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
             for _ in range(cfg.num_layers)]
+
+
+def unstack_params_tree(params: Any, num_layers: int):
+    """jit-safe inverse of the scan_layers stacking: every subtree
+    holding a stacked "layers" entry [L, ...] becomes layers_0..L-1
+    subtrees (recursing through wrappers like ActorCriticModel's
+    "backbone").  XLA lowers the constant-index slices to views/copies
+    it can fuse — used by the rollout engine to decode with an
+    unrolled model twin (the stacked cache carried through nn.scan
+    costs ~2x decode time; see RolloutEngine)."""
+    if not isinstance(params, dict):
+        return params
+    out = {}
+    for k, v in params.items():
+        if k == "layers":
+            for i in range(num_layers):
+                out[f"layers_{i}"] = jax.tree.map(lambda x: x[i], v)
+        elif isinstance(v, dict):
+            out[k] = unstack_params_tree(v, num_layers)
+        else:
+            out[k] = v
+    return out
 
 
 def init_params(model: nn.Module, rng: jax.Array, cfg: ModelConfig,

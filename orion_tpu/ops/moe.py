@@ -1,0 +1,126 @@
+"""Mixture-of-Experts layer with expert parallelism (SURVEY.md §2
+parallelism table, row EP: "mesh expert axis + ragged all-to-all;
+lowest priority").
+
+TPU-native design — the GShard/Switch formulation rather than a CUDA
+grouped-GEMM: routing becomes dense one-hot dispatch/combine einsums
+over a fixed per-expert capacity, which XLA tiles onto the MXU and,
+with the expert-stacked parameters sharded over the mesh's ``expert``
+axis, lowers the dispatch/combine contractions into the all-to-all /
+reduce pattern over ICI.  Static shapes throughout (capacity bounds the
+ragged assignment; overflow tokens fall through on the residual path) —
+the same trade the rollout engine makes with paged KV.
+
+No SPEC config uses MoE (BASELINE.json); this exists to make the EP row
+of the parallelism table first-class, as the task demands.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from orion_tpu.config import ModelConfig
+from orion_tpu.models.transformer import _dt
+
+
+def top2_routing(router_logits: jnp.ndarray, n_experts: int,
+                 capacity: int):
+    """GShard top-2 routing with capacity.
+
+    router_logits: [T, E] f32.  Returns (dispatch [T, E, C] bool-ish
+    f32, combine [T, E, C] f32, aux_loss scalar).  Gates of the chosen
+    two experts are renormalized to sum to 1; tokens overflowing an
+    expert's capacity are dropped (their combine weights are 0 — the
+    caller's residual connection carries them unchanged).
+    """
+    T, E = router_logits.shape
+    probs = jax.nn.softmax(router_logits, axis=-1)           # [T, E]
+
+    g1 = jnp.max(probs, axis=-1)
+    e1 = jnp.argmax(probs, axis=-1)
+    probs_wo1 = probs * (1.0 - jax.nn.one_hot(e1, E))
+    g2 = jnp.max(probs_wo1, axis=-1)
+    e2 = jnp.argmax(probs_wo1, axis=-1)
+    denom = jnp.maximum(g1 + g2, 1e-9)
+    g1, g2 = g1 / denom, g2 / denom
+
+    oh1 = jax.nn.one_hot(e1, E)                              # [T, E]
+    oh2 = jax.nn.one_hot(e2, E)
+    # position of each token within its expert's queue (choice-1 tokens
+    # first — they carry the larger gate, so they win capacity).
+    pos1 = jnp.cumsum(oh1, axis=0) * oh1 - oh1               # [T, E]
+    n1 = jnp.sum(oh1, axis=0, keepdims=True)                 # [1, E]
+    pos2 = (jnp.cumsum(oh2, axis=0) - oh2 + n1) * oh2
+    keep1 = oh1 * (pos1 < capacity)
+    keep2 = oh2 * (pos2 < capacity)
+
+    d1 = keep1[:, :, None] * jax.nn.one_hot(
+        pos1.astype(jnp.int32), capacity)                    # [T, E, C]
+    d2 = keep2[:, :, None] * jax.nn.one_hot(
+        pos2.astype(jnp.int32), capacity)
+    dispatch = d1 + d2
+    combine = d1 * g1[:, None, None] + d2 * g2[:, None, None]
+
+    # Load-balance auxiliary loss (Switch eq. 4): fraction of tokens
+    # routed (top-1) x mean router prob, summed over experts, scaled E.
+    frac = jnp.mean(oh1, axis=0)
+    mean_prob = jnp.mean(probs, axis=0)
+    aux = jnp.sum(frac * mean_prob) * E
+    return dispatch, combine, aux
+
+
+class MoEMLP(nn.Module):
+    """Expert-parallel SwiGLU MLP (drop-in for the dense MLP inside a
+    Block when ``cfg.num_experts > 0``).
+
+    Expert params are stacked [E, ...] with logical axis "expert" —
+    LOGICAL_RULES maps it to the mesh's ``expert`` axis, so each device
+    holds E/ep experts and the dispatch/combine einsums become the EP
+    collectives.  The router stays replicated (tiny).
+    """
+
+    cfg: ModelConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        B, L, Dm = x.shape
+        E = cfg.num_experts
+        T = B * L
+        cap = max(1, int(cfg.expert_capacity_factor * 2 * T / E))
+        xt = x.reshape(T, Dm)
+
+        router = nn.Dense(
+            E, use_bias=False, dtype=jnp.float32,
+            param_dtype=_dt(cfg.param_dtype),
+            kernel_init=nn.with_logical_partitioning(
+                nn.initializers.normal(stddev=0.02), ("embed", "norm")),
+            name="router")
+        logits = router(xt.astype(jnp.float32))               # [T, E]
+        dispatch, combine, aux = top2_routing(logits, E, cap)
+        self.sow("intermediates", "moe_aux_loss", aux)
+
+        cdt = _dt(cfg.dtype)
+        expert_in = jnp.einsum("tec,td->ecd", dispatch.astype(cdt),
+                               xt.astype(cdt))                # [E, C, Dm]
+
+        def stacked(name, shape, axes):
+            return self.param(
+                name,
+                nn.with_logical_partitioning(
+                    nn.initializers.normal(stddev=0.02), axes),
+                shape, _dt(cfg.param_dtype))
+
+        I = cfg.intermediate_size
+        wg = stacked("gate_proj", (E, Dm, I), ("expert", "embed", "mlp"))
+        wu = stacked("up_proj", (E, Dm, I), ("expert", "embed", "mlp"))
+        wd = stacked("down_proj", (E, I, Dm), ("expert", "mlp", "embed"))
+        h = nn.silu(jnp.einsum("ecd,edf->ecf", expert_in,
+                               wg.astype(cdt))) * \
+            jnp.einsum("ecd,edf->ecf", expert_in, wu.astype(cdt))
+        y = jnp.einsum("ecf,efd->ecd", h, wd.astype(cdt))     # [E, C, Dm]
+
+        out = jnp.einsum("tec,ecd->td", combine.astype(cdt), y)
+        return out.reshape(B, L, Dm)

@@ -16,17 +16,29 @@ kernels.  Design:
   ``repeat_kv``.
 - Masking is positional, matching the model's semantics exactly
   (models/transformer.py Attention): query at absolute position p
-  attends to KV slot j iff ``j <= p``.  The kernel takes ``q_positions``
-  [B, Lq] instead of a dense O(L^2) mask.
-- Causal skipping happens at two levels: fully-masked blocks skip their
-  compute (``pl.when``), and the *index maps* clamp the fetched block
-  index so skipped steps re-fetch the same block — Pallas elides
-  consecutive identical fetches, so they also cost no HBM bandwidth.
-  Block-extent scalars (per-q-block max position, per-kv-block first
-  relevant q-block) are scalar-prefetched.
+  attends to the KV at absolute position j iff ``j <= p``.  KV
+  positions are an explicit array (``kv_positions``): the standard
+  causal path passes ``arange(Lk)`` (slot == position), and the
+  ring-attention path passes rotated chunk positions — zigzag chunks
+  are piecewise-contiguous, so an offset would not do.
+- Causal skipping: a (q-block, kv-block) pair is skipped when the
+  kv-block's MIN position exceeds the q-block's MAX position
+  (``pl.when``); block-extent scalars (per-q-block max position,
+  per-kv-block min position, per-kv-block first relevant q-block) are
+  scalar-prefetched.  On the standard contiguous path the *index maps*
+  additionally clamp the fetched block index so skipped steps re-fetch
+  the same block — Pallas elides consecutive identical fetches, so
+  they also cost no HBM bandwidth.  (The clamp assumes position
+  monotonicity, so the ring/kv_positions path disables it and relies
+  on the compute skip alone.)
+- Rows with NO valid key (possible per ring chunk) produce out = 0 and
+  lse ≈ -inf — exactly the neutral element of the streaming-softmax
+  merge in parallel.longctx.ring_attention.
 - Backward is the standard two-kernel flash split: dQ over kv-blocks,
   dK/dV over q-blocks, recomputing P from the saved LSE.  For GQA the
-  dK/dV kernel emits per-q-head gradients, group-summed outside.
+  dK/dV kernel emits per-q-head gradients, group-summed outside.  The
+  per-chunk entry points (``flash_chunk_*``) take a caller-supplied
+  GLOBAL lse, which is what makes the ring-attention backward exact.
 
 Interpret mode runs automatically off-TPU (CPU test harness).
 """
@@ -50,34 +62,39 @@ def _pick_block(n: int, preferred: int) -> int:
     return 1
 
 
-def _block_extents(q_positions, bq, bkv, nkv):
-    """(qmax [B, nq], imin [B, nkv]) int32 scalar-prefetch tables.
+def _block_extents(q_positions, kv_positions, bq, bkv):
+    """Scalar-prefetch tables (all int32):
 
-    qmax[b, i]  — largest position in q-block i (clamps how far the kv
-                  sweep must go).
-    imin[b, j]  — first q-block with any position >= j*bkv (where the
-                  q sweep of kv-block j starts).  Positions are
-                  monotonic per row (arange + offset).
+    qmax [B, nq]   — largest position in q-block i.
+    kvmin [B, nkv] — smallest position in kv-block j; pair (i, j) is
+                     fully masked iff kvmin[j] > qmax[i].
+    imin [B, nkv]  — number of q-blocks with qmax < kvmin[j] (= first
+                     relevant q-block when q positions are monotone).
     """
     B, Lq = q_positions.shape
-    qmax = jnp.max(q_positions.reshape(B, Lq // bq, bq), axis=-1)
-    starts = (jnp.arange(nkv, dtype=jnp.int32) * bkv)[None, None, :]
-    n_before = jnp.sum(q_positions[:, :, None] < starts, axis=1)  # [B, nkv]
-    return qmax.astype(jnp.int32), (n_before // bq).astype(jnp.int32)
+    Lk = kv_positions.shape[1]
+    qmax = jnp.max(q_positions.reshape(B, Lq // bq, bq),
+                   axis=-1).astype(jnp.int32)
+    kvmin = jnp.min(kv_positions.reshape(B, Lk // bkv, bkv),
+                    axis=-1).astype(jnp.int32)
+    imin = jnp.sum(qmax[:, :, None] < kvmin[:, None, :],
+                   axis=1).astype(jnp.int32)
+    return qmax, imin, kvmin
 
 
 # ---------------------------------------------------------------------------
 # forward.  Internal layout: q/k/v/o [B, H, L, D]; qpos [B, Lq, 1];
-# lse [B, H, Lq, 1].  Grid (B, H, nq, nkv), kv innermost.
+# kvpos [B, 1, Lk] (lane-major: the kv-position vector broadcasts
+# along lanes in the mask compare; a sublane-major [B, Lk, 1] layout
+# forces a giant Mosaic relayout that blows scoped VMEM); lse [B, H, Lq, 1].  Grid (B, H, nq, nkv), kv innermost.
 # ---------------------------------------------------------------------------
 
 
-def _fwd_kernel(qmax_ref, imin_ref, qpos_ref, q_ref, k_ref, v_ref,
-                o_ref, lse_ref, m_sc, l_sc, acc_sc, *, scale: float,
-                blk_kv: int):
+def _fwd_kernel(qmax_ref, imin_ref, kvmin_ref, qpos_ref, kvpos_ref,
+                q_ref, k_ref, v_ref, o_ref, lse_ref, m_sc, l_sc, acc_sc,
+                *, scale: float):
     b, i, j = pl.program_id(0), pl.program_id(2), pl.program_id(3)
     nj = pl.num_programs(3)
-    blk_q = q_ref.shape[2]
 
     @pl.when(j == 0)
     def _():
@@ -85,18 +102,17 @@ def _fwd_kernel(qmax_ref, imin_ref, qpos_ref, q_ref, k_ref, v_ref,
         l_sc[:, :] = jnp.zeros_like(l_sc)
         acc_sc[:, :] = jnp.zeros_like(acc_sc)
 
-    @pl.when(j * blk_kv <= qmax_ref[b, i])
+    @pl.when(kvmin_ref[b, j] <= qmax_ref[b, i])
     def _():
         q = q_ref[0, 0, :, :].astype(jnp.float32) * scale        # [bq, D]
         qpos = qpos_ref[0, :, 0]
+        kvpos = kvpos_ref[0, 0, :]
         k = k_ref[0, 0, :, :].astype(jnp.float32)                # [bkv, D]
         v = v_ref[0, 0, :, :].astype(jnp.float32)
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)                  # [bq, bkv]
-        kv_idx = j * blk_kv + jax.lax.broadcasted_iota(
-            jnp.int32, (blk_q, blk_kv), 1)
-        s = jnp.where(kv_idx <= qpos[:, None], s, NEG_INF)
+        s = jnp.where(kvpos[None, :] <= qpos[:, None], s, NEG_INF)
         m_prev, l_prev = m_sc[:, :], l_sc[:, :]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
         p = jnp.exp(s - m_new)
@@ -108,40 +124,59 @@ def _fwd_kernel(qmax_ref, imin_ref, qpos_ref, q_ref, k_ref, v_ref,
 
     @pl.when(j == nj - 1)
     def _():
-        o_ref[0, 0, :, :] = (acc_sc[:, :] / l_sc[:, :]).astype(o_ref.dtype)
-        lse_ref[0, 0, :, :] = m_sc[:, :] + jnp.log(l_sc[:, :])
+        # Rows with no valid key at all (possible per ring chunk) keep
+        # l = 0: guard the division -> o = 0, lse ≈ NEG_INF (the merge
+        # neutral element).
+        l_safe = jnp.maximum(l_sc[:, :], 1e-30)
+        o_ref[0, 0, :, :] = (acc_sc[:, :] / l_safe).astype(o_ref.dtype)
+        lse_ref[0, 0, :, :] = m_sc[:, :] + jnp.log(l_safe)
 
 
-def _fwd(qt, kt, vt, qpos3, scale, blk_q, blk_kv):
-    """qt [B,H,Lq,D], kt/vt [B,Hkv,Lk,D], qpos3 [B,Lq,1]."""
+def _fwd(qt, kt, vt, qpos3, kvpos3, scale, blk_q, blk_kv,
+         clamp: bool):
+    """qt [B,H,Lq,D], kt/vt [B,Hkv,Lk,D], qpos3 [B,Lq,1], kvpos3
+    [B,1,Lk].  clamp=True enables the contiguous-path fetch clamps."""
     B, H, Lq, D = qt.shape
     Hkv, Lk = kt.shape[1], kt.shape[2]
     n_rep = H // Hkv
     bq = _pick_block(Lq, blk_q)
     bkv = _pick_block(Lk, blk_kv)
     nq, nkv = Lq // bq, Lk // bkv
-    qmax, imin = _block_extents(qpos3[:, :, 0], bq, bkv, nkv)
+    qmax, imin, kvmin = _block_extents(qpos3[:, :, 0], kvpos3[:, 0, :],
+                                       bq, bkv)
 
-    def kv_map(b, h, i, j, qmax, imin, r=n_rep, bkv=bkv):
-        # Clamp: steps beyond the causal frontier re-fetch the same
-        # block, which Pallas elides.
-        return (b, h // r, jnp.minimum(j, qmax[b, i] // bkv), 0)
+    if clamp:
+        def kv_map(b, h, i, j, qmax, imin, kvmin, r=n_rep, bkv=bkv):
+            # Steps beyond the causal frontier re-fetch the same block,
+            # which Pallas elides.  (Contiguous kv positions only.)
+            return (b, h // r, jnp.minimum(j, qmax[b, i] // bkv), 0)
+
+        def kvpos_map(b, h, i, j, qmax, imin, kvmin, bkv=bkv):
+            return (b, 0, jnp.minimum(j, qmax[b, i] // bkv))
+    else:
+        def kv_map(b, h, i, j, qmax, imin, kvmin, r=n_rep):
+            return (b, h // r, j, 0)
+
+        def kvpos_map(b, h, i, j, qmax, imin, kvmin):
+            return (b, 0, j)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,
+        num_scalar_prefetch=3,
         grid=(B, H, nq, nkv),
         in_specs=[
-            pl.BlockSpec((1, bq, 1), lambda b, h, i, j, qm, im: (b, i, 0)),
+            pl.BlockSpec((1, bq, 1),
+                         lambda b, h, i, j, qm, im, km: (b, i, 0)),
+            pl.BlockSpec((1, 1, bkv), kvpos_map),
             pl.BlockSpec((1, 1, bq, D),
-                         lambda b, h, i, j, qm, im: (b, h, i, 0)),
+                         lambda b, h, i, j, qm, im, km: (b, h, i, 0)),
             pl.BlockSpec((1, 1, bkv, D), kv_map),
             pl.BlockSpec((1, 1, bkv, D), kv_map),
         ],
         out_specs=[
             pl.BlockSpec((1, 1, bq, D),
-                         lambda b, h, i, j, qm, im: (b, h, i, 0)),
+                         lambda b, h, i, j, qm, im, km: (b, h, i, 0)),
             pl.BlockSpec((1, 1, bq, 1),
-                         lambda b, h, i, j, qm, im: (b, h, i, 0)),
+                         lambda b, h, i, j, qm, im, km: (b, h, i, 0)),
         ],
         scratch_shapes=[
             pltpu.VMEM((bq, 1), jnp.float32),   # running max
@@ -150,14 +185,14 @@ def _fwd(qt, kt, vt, qpos3, scale, blk_q, blk_kv):
         ],
     )
     out, lse = pl.pallas_call(
-        functools.partial(_fwd_kernel, scale=scale, blk_kv=bkv),
+        functools.partial(_fwd_kernel, scale=scale),
         grid_spec=grid_spec,
         out_shape=[
             jax.ShapeDtypeStruct(qt.shape, qt.dtype),
             jax.ShapeDtypeStruct((B, H, Lq, 1), jnp.float32),
         ],
         interpret=interpret_mode(),
-    )(qmax, imin, qpos3, qt, kt, vt)
+    )(qmax, imin, kvmin, qpos3, kvpos3, qt, kt, vt)
     return out, lse
 
 
@@ -166,32 +201,31 @@ def _fwd(qt, kt, vt, qpos3, scale, blk_q, blk_kv):
 # ---------------------------------------------------------------------------
 
 
-def _dq_kernel(qmax_ref, imin_ref, qpos_ref, q_ref, k_ref, v_ref, do_ref,
-               lse_ref, delta_ref, dq_ref, dq_sc, *, scale: float,
-               blk_kv: int):
+def _dq_kernel(qmax_ref, imin_ref, kvmin_ref, qpos_ref, kvpos_ref,
+               q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+               dq_sc, *, scale: float):
     b, i, j = pl.program_id(0), pl.program_id(2), pl.program_id(3)
     nj = pl.num_programs(3)
-    blk_q = q_ref.shape[2]
 
     @pl.when(j == 0)
     def _():
         dq_sc[:, :] = jnp.zeros_like(dq_sc)
 
-    @pl.when(j * blk_kv <= qmax_ref[b, i])
+    @pl.when(kvmin_ref[b, j] <= qmax_ref[b, i])
     def _():
         q = q_ref[0, 0, :, :].astype(jnp.float32) * scale
         do = do_ref[0, 0, :, :].astype(jnp.float32)
         lse = lse_ref[0, 0, :, :]
         delta = delta_ref[0, 0, :, :]
         qpos = qpos_ref[0, :, 0]
+        kvpos = kvpos_ref[0, 0, :]
         k = k_ref[0, 0, :, :].astype(jnp.float32)
         v = v_ref[0, 0, :, :].astype(jnp.float32)
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
-        kv_idx = j * blk_kv + jax.lax.broadcasted_iota(
-            jnp.int32, (blk_q, blk_kv), 1)
-        p = jnp.where(kv_idx <= qpos[:, None], jnp.exp(s - lse), 0.0)
+        p = jnp.where(kvpos[None, :] <= qpos[:, None],
+                      jnp.exp(s - lse), 0.0)
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -204,33 +238,32 @@ def _dq_kernel(qmax_ref, imin_ref, qpos_ref, q_ref, k_ref, v_ref, do_ref,
         dq_ref[0, 0, :, :] = (dq_sc[:, :] * scale).astype(dq_ref.dtype)
 
 
-def _dkv_kernel(qmax_ref, imin_ref, qpos_ref, q_ref, k_ref, v_ref, do_ref,
-                lse_ref, delta_ref, dk_ref, dv_ref, dk_sc, dv_sc, *,
-                scale: float, blk_q: int):
+def _dkv_kernel(qmax_ref, imin_ref, kvmin_ref, qpos_ref, kvpos_ref,
+                q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                dk_ref, dv_ref, dk_sc, dv_sc, *, scale: float):
     b, j, i = pl.program_id(0), pl.program_id(2), pl.program_id(3)
     ni = pl.num_programs(3)
-    blk_kv = k_ref.shape[2]
 
     @pl.when(i == 0)
     def _():
         dk_sc[:, :] = jnp.zeros_like(dk_sc)
         dv_sc[:, :] = jnp.zeros_like(dv_sc)
 
-    @pl.when(i >= imin_ref[b, j])
+    @pl.when(qmax_ref[b, i] >= kvmin_ref[b, j])
     def _():
         q = q_ref[0, 0, :, :].astype(jnp.float32) * scale
         do = do_ref[0, 0, :, :].astype(jnp.float32)
         lse = lse_ref[0, 0, :, :]
         delta = delta_ref[0, 0, :, :]
         qpos = qpos_ref[0, :, 0]
+        kvpos = kvpos_ref[0, 0, :]
         k = k_ref[0, 0, :, :].astype(jnp.float32)
         v = v_ref[0, 0, :, :].astype(jnp.float32)
-        kv_idx = j * blk_kv + jax.lax.broadcasted_iota(
-            jnp.int32, (blk_q, blk_kv), 1)
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)               # [bq, bkv]
-        p = jnp.where(kv_idx <= qpos[:, None], jnp.exp(s - lse), 0.0)
+        p = jnp.where(kvpos[None, :] <= qpos[:, None],
+                      jnp.exp(s - lse), 0.0)
         dv_sc[:, :] = dv_sc[:, :] + jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)               # [bkv, D]
@@ -248,34 +281,43 @@ def _dkv_kernel(qmax_ref, imin_ref, qpos_ref, q_ref, k_ref, v_ref, do_ref,
         dv_ref[0, 0, :, :] = dv_sc[:, :].astype(dv_ref.dtype)
 
 
-def _bwd_impl(qt, kt, vt, qpos3, scale, blk_q, blk_kv, out_t, lse, dout_t):
+def _dq_call(qt, kt, vt, qpos3, kvpos3, dout_t, lse, delta, scale,
+             blk_q, blk_kv, clamp: bool):
     B, H, Lq, D = qt.shape
     Hkv, Lk = kt.shape[1], kt.shape[2]
     n_rep = H // Hkv
     bq = _pick_block(Lq, blk_q)
     bkv = _pick_block(Lk, blk_kv)
     nq, nkv = Lq // bq, Lk // bkv
-    qmax, imin = _block_extents(qpos3[:, :, 0], bq, bkv, nkv)
+    qmax, imin, kvmin = _block_extents(qpos3[:, :, 0], kvpos3[:, 0, :],
+                                       bq, bkv)
 
-    # delta = rowsum(dO * O) — cheap elementwise, plain XLA.
-    delta = jnp.sum(dout_t.astype(jnp.float32) * out_t.astype(jnp.float32),
-                    axis=-1, keepdims=True)                   # [B, H, Lq, 1]
+    if clamp:
+        def kv_map(b, h, i, j, qm, im, km, r=n_rep, bkv=bkv):
+            return (b, h // r, jnp.minimum(j, qm[b, i] // bkv), 0)
 
-    def kv_map(b, h, i, j, qm, im, r=n_rep, bkv=bkv):
-        return (b, h // r, jnp.minimum(j, qm[b, i] // bkv), 0)
+        def kvpos_map(b, h, i, j, qm, im, km, bkv=bkv):
+            return (b, 0, jnp.minimum(j, qm[b, i] // bkv))
+    else:
+        def kv_map(b, h, i, j, qm, im, km, r=n_rep):
+            return (b, h // r, j, 0)
+
+        def kvpos_map(b, h, i, j, qm, im, km):
+            return (b, 0, j)
 
     q_spec = pl.BlockSpec((1, 1, bq, D),
-                          lambda b, h, i, j, qm, im: (b, h, i, 0))
+                          lambda b, h, i, j, qm, im, km: (b, h, i, 0))
     row_spec = pl.BlockSpec((1, 1, bq, 1),
-                            lambda b, h, i, j, qm, im: (b, h, i, 0))
-    dq = pl.pallas_call(
-        functools.partial(_dq_kernel, scale=scale, blk_kv=bkv),
+                            lambda b, h, i, j, qm, im, km: (b, h, i, 0))
+    return pl.pallas_call(
+        functools.partial(_dq_kernel, scale=scale),
         grid_spec=pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=2,
+            num_scalar_prefetch=3,
             grid=(B, H, nq, nkv),
             in_specs=[
                 pl.BlockSpec((1, bq, 1),
-                             lambda b, h, i, j, qm, im: (b, i, 0)),
+                             lambda b, h, i, j, qm, im, km: (b, i, 0)),
+                pl.BlockSpec((1, 1, bkv), kvpos_map),
                 q_spec,
                 pl.BlockSpec((1, 1, bkv, D), kv_map),
                 pl.BlockSpec((1, 1, bkv, D), kv_map),
@@ -288,32 +330,60 @@ def _bwd_impl(qt, kt, vt, qpos3, scale, blk_q, blk_kv, out_t, lse, dout_t):
         ),
         out_shape=jax.ShapeDtypeStruct(qt.shape, qt.dtype),
         interpret=interpret_mode(),
-    )(qmax, imin, qpos3, qt, kt, vt, dout_t, lse, delta)
+    )(qmax, imin, kvmin, qpos3, kvpos3, qt, kt, vt, dout_t, lse, delta)
 
-    # dK/dV per q-head (grid q innermost), then group-sum GQA repeats.
-    def q_map(b, h, j, i, qm, im, bq=bq):
-        # Clamp: q-blocks before this kv-block's causal frontier re-fetch
-        # the first relevant block.
-        return (b, h, jnp.maximum(i, im[b, j]), 0)
 
-    def q_row_map(b, h, j, i, qm, im, bq=bq):
-        return (b, h, jnp.maximum(i, im[b, j]), 0)
+def _dkv_call(qt, kt, vt, qpos3, kvpos3, dout_t, lse, delta, scale,
+              blk_q, blk_kv, clamp: bool):
+    """Per-q-head dK/dV [B, H, Lk, D] f32 (caller group-sums GQA)."""
+    B, H, Lq, D = qt.shape
+    Hkv, Lk = kt.shape[1], kt.shape[2]
+    n_rep = H // Hkv
+    bq = _pick_block(Lq, blk_q)
+    bkv = _pick_block(Lk, blk_kv)
+    nq, nkv = Lq // bq, Lk // bkv
+    qmax, imin, kvmin = _block_extents(qpos3[:, :, 0], kvpos3[:, 0, :],
+                                       bq, bkv)
+
+    if clamp:
+        def q_map(b, h, j, i, qm, im, km):
+            # q-blocks before this kv-block's causal frontier re-fetch
+            # the first relevant block (monotone positions only).
+            return (b, h, jnp.maximum(i, im[b, j]), 0)
+
+        def q_row_map(b, h, j, i, qm, im, km):
+            return (b, h, jnp.maximum(i, im[b, j]), 0)
+
+        def qpos_map(b, h, j, i, qm, im, km):
+            return (b, jnp.maximum(i, im[b, j]), 0)
+    else:
+        def q_map(b, h, j, i, qm, im, km):
+            return (b, h, i, 0)
+
+        def q_row_map(b, h, j, i, qm, im, km):
+            return (b, h, i, 0)
+
+        def qpos_map(b, h, j, i, qm, im, km):
+            return (b, i, 0)
 
     kv_out_spec = pl.BlockSpec((1, 1, bkv, D),
-                               lambda b, h, j, i, qm, im: (b, h, j, 0))
+                               lambda b, h, j, i, qm, im, km: (b, h, j, 0))
     dk_h, dv_h = pl.pallas_call(
-        functools.partial(_dkv_kernel, scale=scale, blk_q=bq),
+        functools.partial(_dkv_kernel, scale=scale),
         grid_spec=pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=2,
+            num_scalar_prefetch=3,
             grid=(B, H, nkv, nq),
             in_specs=[
-                pl.BlockSpec((1, bq, 1),
-                             lambda b, h, j, i, qm, im: (b, jnp.maximum(i, im[b, j]), 0)),
+                pl.BlockSpec((1, bq, 1), qpos_map),
+                pl.BlockSpec((1, 1, bkv),
+                             lambda b, h, j, i, qm, im, km: (b, 0, j)),
                 pl.BlockSpec((1, 1, bq, D), q_map),
                 pl.BlockSpec((1, 1, bkv, D),
-                             lambda b, h, j, i, qm, im, r=n_rep: (b, h // r, j, 0)),
+                             lambda b, h, j, i, qm, im, km, r=n_rep:
+                             (b, h // r, j, 0)),
                 pl.BlockSpec((1, 1, bkv, D),
-                             lambda b, h, j, i, qm, im, r=n_rep: (b, h // r, j, 0)),
+                             lambda b, h, j, i, qm, im, km, r=n_rep:
+                             (b, h // r, j, 0)),
                 pl.BlockSpec((1, 1, bq, D), q_map),
                 pl.BlockSpec((1, 1, bq, 1), q_row_map),
                 pl.BlockSpec((1, 1, bq, 1), q_row_map),
@@ -329,8 +399,22 @@ def _bwd_impl(qt, kt, vt, qpos3, scale, blk_q, blk_kv, out_t, lse, dout_t):
             jax.ShapeDtypeStruct((B, H, Lk, D), jnp.float32),
         ],
         interpret=interpret_mode(),
-    )(qmax, imin, qpos3, qt, kt, vt, dout_t, lse, delta)
+    )(qmax, imin, kvmin, qpos3, kvpos3, qt, kt, vt, dout_t, lse, delta)
+    return dk_h, dv_h
 
+
+def _bwd_impl(qt, kt, vt, qpos3, kvpos3, scale, blk_q, blk_kv, out_t,
+              lse, dout_t, clamp: bool):
+    B, H, Lq, D = qt.shape
+    Hkv, Lk = kt.shape[1], kt.shape[2]
+    n_rep = H // Hkv
+    # delta = rowsum(dO * O) — cheap elementwise, plain XLA.
+    delta = jnp.sum(dout_t.astype(jnp.float32) * out_t.astype(jnp.float32),
+                    axis=-1, keepdims=True)                   # [B, H, Lq, 1]
+    dq = _dq_call(qt, kt, vt, qpos3, kvpos3, dout_t, lse, delta, scale,
+                  blk_q, blk_kv, clamp)
+    dk_h, dv_h = _dkv_call(qt, kt, vt, qpos3, kvpos3, dout_t, lse, delta,
+                           scale, blk_q, blk_kv, clamp)
     if n_rep > 1:
         dk = dk_h.reshape(B, Hkv, n_rep, Lk, D).sum(axis=2)
         dv = dv_h.reshape(B, Hkv, n_rep, Lk, D).sum(axis=2)
@@ -342,6 +426,11 @@ def _bwd_impl(qt, kt, vt, qpos3, scale, blk_q, blk_kv, out_t, lse, dout_t):
 # ---------------------------------------------------------------------------
 # public entry (custom VJP), model layout [B, L, H, D]
 # ---------------------------------------------------------------------------
+
+
+def _arange_kvpos(B, Lk):
+    return jnp.broadcast_to(jnp.arange(Lk, dtype=jnp.int32)[None, :],
+                            (B, Lk))
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
@@ -358,25 +447,32 @@ def flash_attention_gqa(q, k, v, q_positions, scale,
     to the reference attention mask built in models/transformer.py).
     Returns [B, Lq, H, D] in q.dtype.
     """
+    B, Lk = k.shape[0], k.shape[1]
     out, _ = _fwd(q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
                   v.transpose(0, 2, 1, 3), q_positions[:, :, None],
-                  scale, blk_q, blk_kv)
+                  _arange_kvpos(B, Lk)[:, None, :],
+                  scale, blk_q, blk_kv, clamp=True)
     return out.transpose(0, 2, 1, 3)
 
 
 def _vjp_fwd(q, k, v, q_positions, scale, blk_q, blk_kv):
+    B, Lk = k.shape[0], k.shape[1]
     qt = q.transpose(0, 2, 1, 3)
     kt = k.transpose(0, 2, 1, 3)
     vt = v.transpose(0, 2, 1, 3)
     qpos3 = q_positions[:, :, None]
-    out_t, lse = _fwd(qt, kt, vt, qpos3, scale, blk_q, blk_kv)
-    return out_t.transpose(0, 2, 1, 3), (qt, kt, vt, qpos3, out_t, lse)
+    kvpos3 = _arange_kvpos(B, Lk)[:, None, :]
+    out_t, lse = _fwd(qt, kt, vt, qpos3, kvpos3, scale, blk_q, blk_kv,
+                      clamp=True)
+    return out_t.transpose(0, 2, 1, 3), (qt, kt, vt, qpos3, kvpos3,
+                                         out_t, lse)
 
 
 def _vjp_bwd(scale, blk_q, blk_kv, residuals, dout):
-    qt, kt, vt, qpos3, out_t, lse = residuals
-    dq, dk, dv = _bwd_impl(qt, kt, vt, qpos3, scale, blk_q, blk_kv,
-                           out_t, lse, dout.transpose(0, 2, 1, 3))
+    qt, kt, vt, qpos3, kvpos3, out_t, lse = residuals
+    dq, dk, dv = _bwd_impl(qt, kt, vt, qpos3, kvpos3, scale, blk_q,
+                           blk_kv, out_t, lse, dout.transpose(0, 2, 1, 3),
+                           clamp=True)
     return (dq.transpose(0, 2, 1, 3),
             dk.transpose(0, 2, 1, 3).astype(kt.dtype),
             dv.transpose(0, 2, 1, 3).astype(vt.dtype),
@@ -384,3 +480,42 @@ def _vjp_bwd(scale, blk_q, blk_kv, residuals, dout):
 
 
 flash_attention_gqa.defvjp(_vjp_fwd, _vjp_bwd)
+
+
+# ---------------------------------------------------------------------------
+# per-chunk entries for ring attention (parallel.longctx)
+# ---------------------------------------------------------------------------
+
+
+def flash_chunk_fwd(q, k, v, q_positions, kv_positions, scale,
+                    blk_q: int = 256, blk_kv: int = 512):
+    """One ring chunk, flash-blockwise: returns (out [B, Lq, H, D]
+    normalized WITHIN the chunk, lse [B, H, Lq] f32).  kv_positions
+    [B, Lk] are arbitrary absolute positions (rotated zigzag chunks);
+    fully-masked rows give out = 0, lse ≈ -inf.  No VJP — the ring
+    caller owns the backward (flash_chunk_grads with the global lse)."""
+    out_t, lse = _fwd(q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
+                      v.transpose(0, 2, 1, 3), q_positions[:, :, None],
+                      kv_positions[:, None, :], scale, blk_q, blk_kv,
+                      clamp=False)
+    return out_t.transpose(0, 2, 1, 3), lse[..., 0]
+
+
+def flash_chunk_grads(q, k, v, q_positions, kv_positions, out, lse,
+                      dout, scale, blk_q: int = 256, blk_kv: int = 512):
+    """Per-chunk flash backward against the GLOBAL softmax statistics:
+    ``lse`` [B, H, Lq] is the all-chunks log-sum-exp and ``out``/
+    ``dout`` the FINAL merged output/cotangent — p = exp(s - lse)
+    reconstructs this chunk's exact global attention weights, so the
+    returned (dq_partial, dk, dv) are exact per-chunk contributions
+    (dq sums over chunks; dk/dv are complete for this chunk's KV)."""
+    qt = q.transpose(0, 2, 1, 3)
+    kt = k.transpose(0, 2, 1, 3)
+    vt = v.transpose(0, 2, 1, 3)
+    dq, dk, dv = _bwd_impl(
+        qt, kt, vt, q_positions[:, :, None], kv_positions[:, None, :],
+        scale, blk_q, blk_kv, out.transpose(0, 2, 1, 3), lse[..., None],
+        dout.transpose(0, 2, 1, 3), clamp=False)
+    return (dq.transpose(0, 2, 1, 3),
+            dk.transpose(0, 2, 1, 3).astype(k.dtype),
+            dv.transpose(0, 2, 1, 3).astype(v.dtype))

@@ -31,6 +31,7 @@ LOGICAL_RULES: dict = {
     "vocab": "tensor",
     "layers": None,
     "norm": None,
+    "expert": "expert",
     "batch": ("data", "fsdp"),
     "seq": "seq",
 }

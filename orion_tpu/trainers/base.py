@@ -192,17 +192,36 @@ class BaseTrainer:
     # ------------------------------------------------------------------
     # jitted helpers
     # ------------------------------------------------------------------
+    def _policy_apply(self, params, sequences, positions):
+        """(logits, aux): policy forward + the MoE router load-balance
+        auxiliary loss (mean over layers; 0.0 for dense models).  Loss
+        paths add ``cfg.model.router_aux_coef * aux`` — without it a
+        num_experts>0 run has zero load-balancing pressure and experts
+        silently collapse."""
+        if self.cfg.model.num_experts > 0:
+            (logits, _), inter = self.model.apply(
+                {"params": params}, sequences, positions,
+                mutable=["intermediates"])
+            leaves = jax.tree.leaves(inter)
+            aux = sum(jnp.mean(x) for x in leaves) / max(len(leaves), 1)
+        else:
+            logits, _ = self.model.apply({"params": params}, sequences,
+                                         positions)
+            aux = jnp.zeros((), jnp.float32)
+        return logits, aux
+
     def _logprobs_fn(self, params, sequences, prompt_lens, max_new: int):
-        """Completion logprobs + entropy under the training graph."""
+        """Completion logprobs + entropy (+ MoE aux loss) under the
+        training graph."""
         positions = jnp.broadcast_to(
             jnp.arange(sequences.shape[1], dtype=jnp.int32), sequences.shape)
-        logits, _ = self.model.apply({"params": params}, sequences, positions)
+        logits, aux = self._policy_apply(params, sequences, positions)
         lp = completion_logprobs(logits, sequences, prompt_lens, max_new)
         ent = entropy_from_logits(logits)
         idx = jnp.clip(
             prompt_lens[:, None] + jnp.arange(max_new)[None, :] - 1,
             0, logits.shape[1] - 1)
-        return lp, jnp.take_along_axis(ent, idx, axis=1)
+        return lp, (jnp.take_along_axis(ent, idx, axis=1), aux)
 
     def loss_fn(self, params, mb: Dict[str, jnp.ndarray]):
         raise NotImplementedError

@@ -103,8 +103,16 @@ class PPOTrainer(BaseTrainer):
         positions = jnp.broadcast_to(
             jnp.arange(sequences.shape[1], dtype=jnp.int32),
             sequences.shape)
-        logits, values, _ = self.model.apply(
-            {"params": params}, sequences, positions, with_values=True)
+        if self.cfg.model.num_experts > 0:
+            (logits, values, _), inter = self.model.apply(
+                {"params": params}, sequences, positions, with_values=True,
+                mutable=["intermediates"])
+            leaves = jax.tree.leaves(inter)
+            aux = sum(jnp.mean(x) for x in leaves) / max(len(leaves), 1)
+        else:
+            logits, values, _ = self.model.apply(
+                {"params": params}, sequences, positions, with_values=True)
+            aux = jnp.zeros((), jnp.float32)
         from orion_tpu.ops.logprobs import (completion_logprobs,
                                             entropy_from_logits)
 
@@ -117,7 +125,7 @@ class PPOTrainer(BaseTrainer):
                 0, logits.shape[1] - 1)
             ent = jnp.take_along_axis(ent, idx, axis=1)
         return (lp, ent,
-                self._gather_completion(values, prompt_lens, mask))
+                self._gather_completion(values, prompt_lens, mask), aux)
 
     # ------------------------------------------------------------------
     def build_experience(self, result, scores, host=None):
@@ -125,7 +133,7 @@ class PPOTrainer(BaseTrainer):
         mask = result.completion_mask
         if self.cfg.share_backbone and not self.cfg.async_mode:
             # One fused trunk pass yields old logprobs AND values.
-            old_lp, _, values = self._jit_lp_values(
+            old_lp, _, values, _ = self._jit_lp_values(
                 self.state.params, result.sequences, result.prompt_lens,
                 mask, max_new=T, with_entropy=False)
         else:
@@ -181,7 +189,7 @@ class PPOTrainer(BaseTrainer):
         forward/backward.  Flows through BaseTrainer's scanned epoch
         program (_epochs_fn) unchanged."""
         T = mb["mask"].shape[1]
-        lp, ent, values = self._lp_values_fwd(
+        lp, ent, values, aux = self._lp_values_fwd(
             params, mb["sequences"], mb["prompt_lens"], mb["mask"],
             max_new=T)
         p_loss, p_stats = ppo_policy_loss(
@@ -192,15 +200,17 @@ class PPOTrainer(BaseTrainer):
             self.cfg.value_clip)
         stats = {**p_stats, **v_stats}
         stats["entropy"] = masked_mean(ent, mb["mask"])
-        return p_loss + self.cfg.vf_coef * v_loss, stats
+        return (p_loss + self.cfg.vf_coef * v_loss
+                + self.cfg.model.router_aux_coef * aux), stats
 
     def _policy_loss(self, params, mb):
         T = mb["mask"].shape[1]
-        lp, ent = self._logprobs_fn(
+        lp, (ent, aux) = self._logprobs_fn(
             params, mb["sequences"], mb["prompt_lens"], max_new=T)
         loss, stats = ppo_policy_loss(
             lp, mb["old_logprobs"], mb["advantages"], mb["mask"],
             self.cfg.clip_ratio)
+        loss = loss + self.cfg.model.router_aux_coef * aux
         stats = dict(stats)
         stats["entropy"] = masked_mean(ent, mb["mask"])
         return loss, stats

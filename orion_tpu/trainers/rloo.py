@@ -52,7 +52,7 @@ class RLOOTrainer(BaseTrainer):
 
     def loss_fn(self, params, mb: Dict[str, jnp.ndarray]):
         T = mb["mask"].shape[1]
-        lp, ent = self._logprobs_fn(
+        lp, (ent, aux) = self._logprobs_fn(
             params, mb["sequences"], mb["prompt_lens"], max_new=T)
         seq_lp = jnp.sum(lp * mb["mask"], axis=1)
         # REINFORCE on whole-sequence logprob with a stop-grad sequence
@@ -62,7 +62,8 @@ class RLOOTrainer(BaseTrainer):
         old_seq_lp = jnp.sum(mb["old_logprobs"] * mb["mask"], axis=1)
         ratio = jax.lax.stop_gradient(
             jnp.exp(jnp.clip(seq_lp - old_seq_lp, -10.0, 10.0)))
-        loss = -jnp.mean(mb["advantages"] * ratio * seq_lp)
+        loss = -jnp.mean(mb["advantages"] * ratio * seq_lp) \
+            + self.cfg.model.router_aux_coef * aux
         stats = {
             "policy_loss": loss,
             "entropy": masked_mean(ent, mb["mask"]),

@@ -193,3 +193,58 @@ def test_zigzag_roundtrip_and_balance():
     per_dev = order.reshape(S, L // S)
     sums = per_dev.sum(axis=1)
     assert np.all(sums == sums[0])
+
+
+def test_flash_chunk_fully_masked_rows():
+    """A ring chunk whose KV positions are ALL in the future must give
+    out = 0 and lse ~ -inf (the streaming-merge neutral element)."""
+    from orion_tpu.ops.pallas.flash_attention import flash_chunk_fwd
+
+    B, L, H, D = 1, 8, 2, 16
+    ks = jax.random.split(jax.random.key(0), 3)
+    q = jax.random.normal(ks[0], (B, L, H, D), jnp.float32)
+    k = jax.random.normal(ks[1], (B, L, H, D), jnp.float32)
+    v = jax.random.normal(ks[2], (B, L, H, D), jnp.float32)
+    qpos = jnp.broadcast_to(jnp.arange(8, dtype=jnp.int32), (B, L))
+    kvpos = qpos + 1000  # entirely in the future
+    out, lse = flash_chunk_fwd(q, k, v, qpos, kvpos, 0.25)
+    np.testing.assert_array_equal(np.asarray(out), 0.0)
+    assert float(jnp.max(lse)) < -1e20
+
+
+def test_ring_matches_reference_ring():
+    """Flash-blockwise ring == dense-per-chunk ring (same collective
+    schedule, different per-chunk math), zigzag layout."""
+    from orion_tpu.parallel.longctx import (ring_attention,
+                                            ring_attention_reference,
+                                            zigzag_order)
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+    from orion_tpu.parallel.mesh import make_mesh
+    from orion_tpu.config import MeshConfig
+
+    s = 4
+    mesh = make_mesh(MeshConfig(data=1, fsdp=1, seq=s, tensor=1),
+                     jax.devices()[:4])
+    B, L, H, D = 2, 32, 4, 16
+    ks = jax.random.split(jax.random.key(3), 3)
+    q = jax.random.normal(ks[0], (B, L, H, D), jnp.float32)
+    k = jax.random.normal(ks[1], (B, L, H, D), jnp.float32)
+    v = jax.random.normal(ks[2], (B, L, H, D), jnp.float32)
+    order = zigzag_order(L, s)
+    pos = jnp.broadcast_to(jnp.asarray(order, jnp.int32), (B, L))
+    qz, kz, vz = q[:, order], k[:, order], v[:, order]
+
+    def run(fn):
+        mapped = shard_map(
+            lambda *a: fn(*a, 0.25),
+            mesh=mesh,
+            in_specs=(P(None, "seq"), P(None, "seq"), P(None, "seq"),
+                      P(None, "seq"), P(None, "seq")),
+            out_specs=P(None, "seq"), check_vma=False)
+        return jax.jit(mapped)(qz, kz, vz, pos, pos)
+
+    np.testing.assert_allclose(
+        np.asarray(run(ring_attention)),
+        np.asarray(run(ring_attention_reference)),
+        rtol=2e-5, atol=2e-5)

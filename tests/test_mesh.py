@@ -14,13 +14,15 @@ def test_eight_fake_devices():
 
 
 def test_mesh_resolution():
-    # axis order: (stage, data, fsdp, seq, tensor)
+    # axis order: (stage, data, fsdp, seq, expert, tensor)
     cfg = MeshConfig(data=1, fsdp=-1, seq=1, tensor=2)
-    assert cfg.resolved_shape(8) == (1, 1, 4, 1, 2)
+    assert cfg.resolved_shape(8) == (1, 1, 4, 1, 1, 2)
     cfg = MeshConfig(data=2, fsdp=2, seq=1, tensor=2)
-    assert cfg.resolved_shape(8) == (1, 2, 2, 1, 2)
+    assert cfg.resolved_shape(8) == (1, 2, 2, 1, 1, 2)
     cfg = MeshConfig(stage=2, data=1, fsdp=2, seq=1, tensor=2)
-    assert cfg.resolved_shape(8) == (2, 1, 2, 1, 2)
+    assert cfg.resolved_shape(8) == (2, 1, 2, 1, 1, 2)
+    cfg = MeshConfig(expert=4, data=1, fsdp=2, tensor=1)
+    assert cfg.resolved_shape(8) == (1, 1, 2, 1, 4, 1)
     with pytest.raises(ValueError):
         MeshConfig(data=3, fsdp=-1).resolved_shape(8)
 
@@ -28,7 +30,7 @@ def test_mesh_resolution():
 def test_make_mesh():
     mesh = make_mesh(MeshConfig(data=2, fsdp=2, seq=1, tensor=2))
     assert mesh.shape == {"stage": 1, "data": 2, "fsdp": 2, "seq": 1,
-                          "tensor": 2}
+                          "expert": 1, "tensor": 2}
 
 
 def test_specs():

@@ -1,0 +1,123 @@
+"""Race detection (SURVEY.md §5 "Race detection/sanitizers"): JAX's
+functional core removes data races inside the graph; the risky surface
+is the host-side async machinery.  Fuzz it with adversarial timing
+jitter on both sides of the experience queue, and run the numeric path
+under jax_debug_nans + jax_enable_checks (the CI-sanitizer analogue)."""
+
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from orion_tpu.config import GRPOConfig, MeshConfig
+from orion_tpu.models import Transformer, init_params
+from orion_tpu.models.sharded import make_sharded_model
+from orion_tpu.orchestration import AsyncOrchestrator, split_devices
+from orion_tpu.parallel.mesh import make_mesh
+from orion_tpu.trainers import GRPOTrainer
+
+from test_trainers import lucky_token_reward, prompt_stream, _mk
+
+
+def _jittery_reward(seed, lo=0.0, hi=0.02):
+    rs = np.random.RandomState(seed)
+
+    def reward(result, meta):
+        time.sleep(float(rs.uniform(lo, hi)))
+        return lucky_token_reward(result, meta)
+
+    return reward
+
+
+def _setup(staleness, seed, reward_fn):
+    cfg = _mk(GRPOConfig, group_size=2, kl_coef=0.0, num_epochs=1,
+              seed=seed, async_mode=True, async_staleness=staleness,
+              minibatch_size=4)
+    rollout_devs, train_devs = split_devices(jax.devices(), 4)
+    mesh = make_mesh(MeshConfig(data=1, fsdp=-1, seq=1, tensor=1),
+                     devices=train_devs)
+    model = Transformer(cfg.model)
+    init_args = (jnp.zeros((1, 2), jnp.int32), jnp.zeros((1, 2), jnp.int32))
+    params, _ = make_sharded_model(model, mesh, jax.random.key(0),
+                                   init_args)
+    trainer = GRPOTrainer(cfg, model, params, reward_fn=reward_fn,
+                          eos_token_id=None)
+    return cfg, AsyncOrchestrator(trainer, rollout_devs)
+
+
+@pytest.mark.parametrize("staleness,seed", [(1, 0), (2, 1), (3, 2)])
+def test_fuzz_staleness_invariant_under_timing_jitter(staleness, seed):
+    """Random sleeps on the rollout side (reward fn) race the learner's
+    version bumps; the staleness bound must hold for EVERY step at every
+    queue depth, and versions must be monotone."""
+    cfg, orch = _setup(staleness, seed, _jittery_reward(seed))
+    history = orch.train(prompt_stream(2, 4, seed=seed),
+                         num_iterations=8)
+    assert len(history) == 8
+    for h in history:
+        assert 0 <= h["staleness"] <= staleness, h
+        assert np.isfinite(h["loss"])
+
+
+def test_fuzz_slow_learner_fast_rollout():
+    """Inverted pressure: the learner sleeps, the queue saturates —
+    the rollout worker must block on the gate, never exceed the bound,
+    and never deadlock (joined within the test timeout)."""
+    cfg, orch = _setup(1, 7, _jittery_reward(7, 0.0, 0.002))
+    real_update = orch.trainer.update_epochs
+    rs = np.random.RandomState(11)
+
+    def slow_update(exp):
+        time.sleep(float(rs.uniform(0, 0.05)))
+        return real_update(exp)
+
+    orch.trainer.update_epochs = slow_update
+    history = orch.train(prompt_stream(2, 4, seed=7), num_iterations=6)
+    for h in history:
+        assert 0 <= h["staleness"] <= 1
+    # the worker thread is joined by train(); a leaked thread would
+    # show up as a non-daemon zombie — assert none alive with our name
+    assert not [t for t in threading.enumerate()
+                if t.name == "rollout-worker" and t.is_alive()]
+
+
+def test_concurrent_weight_broadcast_vs_generate():
+    """Hammer the weight-sync channel while the rollout worker reads it:
+    the lock must hand the worker a consistent (params, version) pair —
+    detectable here because a torn read would produce a staleness
+    outside [0, bound] or a deleted-buffer crash."""
+    cfg, orch = _setup(2, 13, _jittery_reward(13))
+    real_bcast = orch._broadcast_weights
+
+    def chatty_bcast():
+        # extra broadcasts between updates widen the race window
+        real_bcast()
+        real_bcast()
+
+    orch._broadcast_weights = chatty_bcast
+    history = orch.train(prompt_stream(2, 4, seed=13), num_iterations=6)
+    for h in history:
+        assert 0 <= h["staleness"] <= 2
+
+
+def test_training_under_debug_nans_and_checks():
+    """jax_debug_nans + jax_enable_checks (SURVEY.md §5: enable in CI):
+    one sync GRPO run end-to-end — any NaN produced by the loss/logprob/
+    advantage math or an internal invariant violation raises here."""
+    cfg = _mk(GRPOConfig, group_size=2, kl_coef=0.1, num_epochs=1,
+              minibatch_size=4)
+    model = Transformer(cfg.model)
+    params = init_params(model, jax.random.key(0), cfg.model)
+    trainer = GRPOTrainer(cfg, model, params,
+                          reward_fn=lucky_token_reward, eos_token_id=1)
+    jax.config.update("jax_debug_nans", True)
+    jax.config.update("jax_enable_checks", True)
+    try:
+        hist = trainer.train(prompt_stream(2, 4), num_iterations=2)
+    finally:
+        jax.config.update("jax_debug_nans", False)
+        jax.config.update("jax_enable_checks", False)
+    assert all(np.isfinite(h["loss"]) for h in hist)
