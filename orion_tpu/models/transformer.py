@@ -246,7 +246,11 @@ class Transformer(nn.Module):
             # logical axis (replicated by LOGICAL_RULES).
             scan_block = nn.scan(
                 block_cls,
-                variable_axes={"params": 0},
+                # "intermediates" must be listed or nn.scan silently
+                # DROPS everything sown inside the scanned block — the
+                # MoE router aux loss would read as zero under
+                # scan_layers with no error.
+                variable_axes={"params": 0, "intermediates": 0},
                 split_rngs={"params": True},
                 in_axes=(nn.broadcast, 0),
                 out_axes=0,
@@ -302,6 +306,30 @@ def init_cache(cfg: ModelConfig, batch: int, max_len: int,
         return {"k": jnp.zeros(stacked, dtype), "v": jnp.zeros(stacked, dtype)}
     return [{"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
             for _ in range(cfg.num_layers)]
+
+
+def make_decode_twin(model: nn.Module, cfg: ModelConfig):
+    """(decode_model, decode_cfg) for the rollout engines: scan_layers
+    models decode through an UNROLLED twin — the stacked [L, ...] cache
+    carried through nn.scan defeats in-place cache updates and costs
+    ~2x decode wall-clock (measured 2.3s -> 1.2s, pythia-1b B=32 T=128
+    on v5e).  Pair with :func:`maybe_unstack_for_decode` on the params
+    inside the jitted program; scan keeps its compile-time win on the
+    train/update graphs.  Identity for unrolled models."""
+    if not cfg.scan_layers:
+        return model, cfg
+    import dataclasses
+
+    dcfg = dataclasses.replace(cfg, scan_layers=False)
+    return type(model)(dcfg), dcfg
+
+
+def maybe_unstack_for_decode(params: Any, cfg: ModelConfig):
+    """Unstack scan-layout params for the decode twin (jit-safe
+    constant-index slices XLA fuses); identity for unrolled models."""
+    if not cfg.scan_layers:
+        return params
+    return unstack_params_tree(params, cfg.num_layers)
 
 
 def unstack_params_tree(params: Any, num_layers: int):

@@ -203,11 +203,14 @@ def _ring_vjp_bwd(scale, axis_name, residuals, dout):
         dq = dq + dq_i
         dk_r = dk_r + dk_i
         dv_r = dv_r + dv_i
-        # dk/dv accumulators travel WITH their chunks; after the full
-        # ring they are back on the owning device.
-        k_r = lax.ppermute(k_r, axis_name, perm)
-        v_r = lax.ppermute(v_r, axis_name, perm)
-        kvp_r = lax.ppermute(kvp_r, axis_name, perm)
+        # dk/dv accumulators travel WITH their chunks and need the full
+        # s rotations to arrive home; k/v/kvpos are only consumed by
+        # the next step's compute, so their final rotation is skipped
+        # (one dead ICI hop of the full local KV otherwise).
+        if step < s - 1:
+            k_r = lax.ppermute(k_r, axis_name, perm)
+            v_r = lax.ppermute(v_r, axis_name, perm)
+            kvp_r = lax.ppermute(kvp_r, axis_name, perm)
         dk_r = lax.ppermute(dk_r, axis_name, perm)
         dv_r = lax.ppermute(dv_r, axis_name, perm)
     return dq, dk_r, dv_r, None, None

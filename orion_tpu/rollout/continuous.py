@@ -55,6 +55,10 @@ class ContinuousBatchingEngine:
         self.pad = pad_token_id
         self.segment_len = (cfg.segment_len if segment_len is None
                             else segment_len)
+        from orion_tpu.models.transformer import make_decode_twin
+
+        self._decode_model, self._decode_cfg = make_decode_twin(
+            model, model_cfg)
         self.slots = cfg.max_batch_size
         ps = cfg.page_size
         self.pages_per_seq = -(-(cfg.max_prompt_len + cfg.max_new_tokens)
@@ -69,16 +73,11 @@ class ContinuousBatchingEngine:
         shape = (self.num_pages + 1, model_cfg.num_kv_heads, ps,
                  model_cfg.head_dim)
         dt = jnp.dtype(model_cfg.dtype)
-        if model_cfg.scan_layers:
-            # Stacked [num_layers, ...] pools matching the scan-path
-            # Transformer's cache pytree layout.
-            stk = (model_cfg.num_layers,) + shape
-            self._pools = {"k_pages": jnp.zeros(stk, dt),
-                           "v_pages": jnp.zeros(stk, dt)}
-        else:
-            self._pools = [{"k_pages": jnp.zeros(shape, dt),
-                            "v_pages": jnp.zeros(shape, dt)}
-                           for _ in range(model_cfg.num_layers)]
+        # Pools always use the unrolled per-layer layout: decode runs
+        # through the unrolled twin regardless of cfg.scan_layers.
+        self._pools = [{"k_pages": jnp.zeros(shape, dt),
+                        "v_pages": jnp.zeros(shape, dt)}
+                       for _ in range(model_cfg.num_layers)]
         self._bt = np.full((self.slots, self.pages_per_seq), self._scratch,
                            np.int32)
         self._params = None
@@ -113,19 +112,11 @@ class ContinuousBatchingEngine:
 
     # -- jitted programs ------------------------------------------------
     def _cache(self, pools, bt):
-        if self.mc.scan_layers:
-            return {"k_pages": pools["k_pages"],
-                    "v_pages": pools["v_pages"],
-                    "block_tables": jnp.broadcast_to(
-                        bt, (self.mc.num_layers,) + bt.shape)}
         return [{"k_pages": p["k_pages"], "v_pages": p["v_pages"],
                  "block_tables": bt} for p in pools]
 
     def _strip(self, cache):
         """Drop block tables from the post-apply cache → pool state."""
-        if self.mc.scan_layers:
-            return {"k_pages": cache["k_pages"],
-                    "v_pages": cache["v_pages"]}
         return [{"k_pages": c["k_pages"], "v_pages": c["v_pages"]}
                 for c in cache]
 
@@ -140,10 +131,13 @@ class ContinuousBatchingEngine:
         Returns (pools, tok0 [B], lp0 [B], plp0 [B]).
         """
         B, P = prompt_ids.shape
+        from orion_tpu.models.transformer import maybe_unstack_for_decode
+
+        params = maybe_unstack_for_decode(params, self.mc)
         positions = jnp.broadcast_to(jnp.arange(P, dtype=jnp.int32), (B, P))
         cache = self._cache(pools, bt_rows)
-        logits, cache = self.model.apply({"params": params}, prompt_ids,
-                                         positions, cache)
+        logits, cache = self._decode_model.apply(
+            {"params": params}, prompt_ids, positions, cache)
         last = jnp.take_along_axis(
             logits, (prompt_lens - 1)[:, None, None], axis=1)[:, 0]
         tok0, lp0, plp0 = sample_tokens(
@@ -161,6 +155,9 @@ class ContinuousBatchingEngine:
         """
         S = cur_tok.shape[0]
         pad = self.pad
+        from orion_tpu.models.transformer import maybe_unstack_for_decode
+
+        params = maybe_unstack_for_decode(params, self.mc)
 
         def body(i, c):
             pools, cur_tok, lengths, done, rng, toks, lps, plps = c
@@ -168,7 +165,7 @@ class ContinuousBatchingEngine:
             # feed cur_tok at position lengths-1? No: cur_tok was sampled
             # for position `lengths`; write it there and predict next.
             positions = lengths[:, None]
-            logits, cache = self.model.apply(
+            logits, cache = self._decode_model.apply(
                 {"params": params}, cur_tok[:, None], positions, cache)
             rng, sub = jax.random.split(rng)
             nxt, lp, plp = sample_tokens(

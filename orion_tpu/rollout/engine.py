@@ -72,21 +72,10 @@ class RolloutEngine:
         self.eos_token_id = eos_token_id
         self.pad_token_id = pad_token_id
         self._params = None
-        # scan_layers models decode through an UNROLLED twin: the
-        # stacked [L, ...] cache carried through nn.scan costs ~2x
-        # decode wall-clock (measured 2.3s -> 1.2s, pythia-1b B=32
-        # T=128 on v5e) because the scan carry defeats in-place cache
-        # updates.  Params are unstacked inside the jitted program
-        # (constant-index slices XLA fuses); scan keeps its
-        # compile-time win on the train/update graphs.
-        if model_cfg.scan_layers:
-            import dataclasses as _dc
+        from orion_tpu.models.transformer import make_decode_twin
 
-            self._decode_cfg = _dc.replace(model_cfg, scan_layers=False)
-            self._decode_model = type(model)(self._decode_cfg)
-        else:
-            self._decode_cfg = model_cfg
-            self._decode_model = model
+        self._decode_model, self._decode_cfg = make_decode_twin(
+            model, model_cfg)
         self._generate_jit = jax.jit(
             self._generate, static_argnames=("max_new_tokens",))
 
@@ -130,10 +119,9 @@ class RolloutEngine:
             params = jax.tree.map(
                 lambda x: x.astype(cdt)
                 if jnp.issubdtype(x.dtype, jnp.floating) else x, params)
-        if self.model_cfg.scan_layers:
-            from orion_tpu.models.transformer import unstack_params_tree
+        from orion_tpu.models.transformer import maybe_unstack_for_decode
 
-            params = unstack_params_tree(params, self.model_cfg.num_layers)
+        params = maybe_unstack_for_decode(params, self.model_cfg)
 
         if cfg.paged:
             from orion_tpu.ops.paged_kv import init_paged_cache

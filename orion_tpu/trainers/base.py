@@ -192,30 +192,32 @@ class BaseTrainer:
     # ------------------------------------------------------------------
     # jitted helpers
     # ------------------------------------------------------------------
-    def _policy_apply(self, params, sequences, positions):
-        """(logits, aux): policy forward + the MoE router load-balance
-        auxiliary loss (mean over layers; 0.0 for dense models).  Loss
-        paths add ``cfg.model.router_aux_coef * aux`` — without it a
-        num_experts>0 run has zero load-balancing pressure and experts
-        silently collapse."""
+    def _policy_apply(self, params, sequences, positions, **apply_kw):
+        """(apply outputs, aux): policy forward + the MoE router
+        load-balance auxiliary loss (mean over layers; 0.0 for dense
+        models).  Loss paths add ``cfg.model.router_aux_coef * aux`` —
+        without it a num_experts>0 run has zero load-balancing pressure
+        and experts silently collapse.  ``apply_kw`` passes through to
+        the module (e.g. with_values=True on ActorCriticModel) — the
+        single source of truth for the aux aggregation."""
         if self.cfg.model.num_experts > 0:
-            (logits, _), inter = self.model.apply(
+            out, inter = self.model.apply(
                 {"params": params}, sequences, positions,
-                mutable=["intermediates"])
+                mutable=["intermediates"], **apply_kw)
             leaves = jax.tree.leaves(inter)
             aux = sum(jnp.mean(x) for x in leaves) / max(len(leaves), 1)
         else:
-            logits, _ = self.model.apply({"params": params}, sequences,
-                                         positions)
+            out = self.model.apply({"params": params}, sequences,
+                                   positions, **apply_kw)
             aux = jnp.zeros((), jnp.float32)
-        return logits, aux
+        return out, aux
 
     def _logprobs_fn(self, params, sequences, prompt_lens, max_new: int):
         """Completion logprobs + entropy (+ MoE aux loss) under the
         training graph."""
         positions = jnp.broadcast_to(
             jnp.arange(sequences.shape[1], dtype=jnp.int32), sequences.shape)
-        logits, aux = self._policy_apply(params, sequences, positions)
+        (logits, _), aux = self._policy_apply(params, sequences, positions)
         lp = completion_logprobs(logits, sequences, prompt_lens, max_new)
         ent = entropy_from_logits(logits)
         idx = jnp.clip(

@@ -103,16 +103,8 @@ class PPOTrainer(BaseTrainer):
         positions = jnp.broadcast_to(
             jnp.arange(sequences.shape[1], dtype=jnp.int32),
             sequences.shape)
-        if self.cfg.model.num_experts > 0:
-            (logits, values, _), inter = self.model.apply(
-                {"params": params}, sequences, positions, with_values=True,
-                mutable=["intermediates"])
-            leaves = jax.tree.leaves(inter)
-            aux = sum(jnp.mean(x) for x in leaves) / max(len(leaves), 1)
-        else:
-            logits, values, _ = self.model.apply(
-                {"params": params}, sequences, positions, with_values=True)
-            aux = jnp.zeros((), jnp.float32)
+        (logits, values, _), aux = self._policy_apply(
+            params, sequences, positions, with_values=True)
         from orion_tpu.ops.logprobs import (completion_logprobs,
                                             entropy_from_logits)
 

@@ -62,10 +62,10 @@ class RLOOTrainer(BaseTrainer):
         old_seq_lp = jnp.sum(mb["old_logprobs"] * mb["mask"], axis=1)
         ratio = jax.lax.stop_gradient(
             jnp.exp(jnp.clip(seq_lp - old_seq_lp, -10.0, 10.0)))
-        loss = -jnp.mean(mb["advantages"] * ratio * seq_lp) \
-            + self.cfg.model.router_aux_coef * aux
+        pg_loss = -jnp.mean(mb["advantages"] * ratio * seq_lp)
+        loss = pg_loss + self.cfg.model.router_aux_coef * aux
         stats = {
-            "policy_loss": loss,
+            "policy_loss": pg_loss,
             "entropy": masked_mean(ent, mb["mask"]),
             "seq_logprob_mean": jnp.mean(seq_lp),
             "ratio_mean": jnp.mean(ratio),
