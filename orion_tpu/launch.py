@@ -14,6 +14,12 @@ Examples (the five SPEC configs, BASELINE.json):
       data.tokenizer=/path/to/pythia-1b reward=model:/path/to/rm
   # 4: async decoupled rollout/learner
   python -m orion_tpu.launch grpo async_mode=true rollout_devices=4
+  # PPO with the shared actor-critic trunk (1B-on-one-chip layout)
+  python -m orion_tpu.launch ppo share_backbone=true \
+      optimizer.mu_dtype=bfloat16 optimizer.nu_dtype=bfloat16 \
+      ref_param_dtype=bfloat16 model.remat=true model.scan_layers=true
+  # continuous-batching rollout engine (slot recycling, ragged lengths)
+  python -m orion_tpu.launch grpo rollout.engine=continuous
 
 Multi-host bring-up: set JAX_COORDINATOR/process env and
 ``jax.distributed.initialize()`` runs before mesh construction.

@@ -48,7 +48,6 @@ class ContinuousBatchingEngine:
     def __init__(self, model, model_cfg: ModelConfig, cfg: RolloutConfig,
                  eos_token_id: Optional[int] = None, pad_token_id: int = 0,
                  segment_len: Optional[int] = None):
-        self.model = model
         self.mc = model_cfg
         self.cfg = cfg
         self.eos = eos_token_id
@@ -57,8 +56,10 @@ class ContinuousBatchingEngine:
                             else segment_len)
         from orion_tpu.models.transformer import make_decode_twin
 
-        self._decode_model, self._decode_cfg = make_decode_twin(
-            model, model_cfg)
+        # All applies go through the (possibly unrolled-twin) decode
+        # model; the scan-layout original is deliberately NOT kept —
+        # the per-layer pools below match the unrolled cache layout.
+        self._decode_model, _ = make_decode_twin(model, model_cfg)
         self.slots = cfg.max_batch_size
         ps = cfg.page_size
         self.pages_per_seq = -(-(cfg.max_prompt_len + cfg.max_new_tokens)
