@@ -144,78 +144,12 @@ def flops_per_sample(n_params, cfg, mean_new: float) -> float:
 def lower_8b_check() -> str:
     """AOT-lower the FULL llama3_8b shared-backbone PPO update step
     (tracing+lowering only — no 8B buffers are allocated).  Returns a
-    short status string for the bench JSON."""
-    import jax
-    import jax.numpy as jnp
+    short status string for the bench JSON.  The multi-chip sharded
+    variant (with .compile()) runs in __graft_entry__.dryrun_multichip;
+    both share orion_tpu.utils.compile_check."""
+    from orion_tpu.utils.compile_check import lower_8b_update
 
-    from orion_tpu.config import ModelConfig, OptimizerConfig, PPOConfig
-
-    t0 = time.perf_counter()
-    cfg = PPOConfig()
-    cfg.model = ModelConfig.llama3_8b()
-    cfg.model.remat = True
-    cfg.model.scan_layers = True
-    cfg.share_backbone = True
-    cfg.optimizer = OptimizerConfig(
-        learning_rate=1e-6, mu_dtype="bfloat16", nu_dtype="bfloat16")
-    cfg.minibatch_size = 1
-    cfg.rollout.max_prompt_len = 256
-    cfg.rollout.max_new_tokens = 128
-
-    from orion_tpu.models import ActorCriticModel
-    from orion_tpu.trainers.base import TrainState, make_optimizer
-    from orion_tpu.trainers.ppo import PPOTrainer
-
-    model = ActorCriticModel(cfg.model)
-    pshape = jax.eval_shape(
-        lambda k: model.init(k, jnp.zeros((1, 2), jnp.int32),
-                             jnp.zeros((1, 2), jnp.int32))["params"],
-        jax.random.key(0))
-    import flax.linen as nn
-    pshape = jax.tree.map(
-        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype),
-        nn.meta.unbox(pshape))
-    tx = make_optimizer(cfg.optimizer)
-    opt_shape = jax.eval_shape(tx.init, pshape)
-    state = TrainState(params=pshape, opt_state=opt_shape,
-                       step=jax.ShapeDtypeStruct((), jnp.int32))
-
-    B, T = cfg.minibatch_size, cfg.rollout.max_new_tokens
-    seq = cfg.rollout.max_prompt_len + T
-    mb = {
-        "sequences": jax.ShapeDtypeStruct((B, seq), jnp.int32),
-        "prompt_lens": jax.ShapeDtypeStruct((B,), jnp.int32),
-        "mask": jax.ShapeDtypeStruct((B, T), jnp.float32),
-        "old_logprobs": jax.ShapeDtypeStruct((B, T), jnp.float32),
-        "old_values": jax.ShapeDtypeStruct((B, T), jnp.float32),
-        "advantages": jax.ShapeDtypeStruct((B, T), jnp.float32),
-        "returns": jax.ShapeDtypeStruct((B, T), jnp.float32),
-    }
-
-    # Unbound-method trick: trace PPOTrainer._update_fn without
-    # building a real 8B trainer (no params are materialized).
-    class _Shell:
-        pass
-
-    shell = _Shell()
-    shell.cfg = cfg
-    shell.model = model
-    shell.tx = tx
-    shell.loss_fn = lambda p, m: PPOTrainer.loss_fn(shell, p, m)
-    shell._lp_values_fwd = \
-        lambda *a, **k: PPOTrainer._lp_values_fwd(shell, *a, **k)
-    shell._gather_completion = PPOTrainer._gather_completion
-
-    from orion_tpu.trainers.base import BaseTrainer
-
-    def update(state, mb):
-        idx = jnp.arange(B)
-        return BaseTrainer._update_fn(shell, state, mb, idx)
-
-    lowered = jax.jit(update).lower(state, mb)
-    del lowered
-    dt = time.perf_counter() - t0
-    return f"ok ({param_count(pshape)/1e9:.2f}B params lowered in {dt:.0f}s)"
+    return lower_8b_update(mesh=None, compile=False)
 
 
 def main() -> None:

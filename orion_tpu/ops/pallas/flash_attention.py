@@ -62,7 +62,7 @@ def _pick_block(n: int, preferred: int) -> int:
     return 1
 
 
-def _block_extents(q_positions, kv_positions, bq, bkv):
+def _block_extents(q_positions, kv_positions, bq, bkv, nkv=None):
     """Scalar-prefetch tables (all int32):
 
     qmax [B, nq]   — largest position in q-block i.
@@ -70,13 +70,19 @@ def _block_extents(q_positions, kv_positions, bq, bkv):
                      fully masked iff kvmin[j] > qmax[i].
     imin [B, nkv]  — number of q-blocks with qmax < kvmin[j] (= first
                      relevant q-block when q positions are monotone).
+
+    kv_positions=None means the standard causal layout (slot ==
+    position): kvmin[b, j] = j * bkv; nkv must then be given.
     """
     B, Lq = q_positions.shape
-    Lk = kv_positions.shape[1]
     qmax = jnp.max(q_positions.reshape(B, Lq // bq, bq),
                    axis=-1).astype(jnp.int32)
-    kvmin = jnp.min(kv_positions.reshape(B, Lk // bkv, bkv),
-                    axis=-1).astype(jnp.int32)
+    if kv_positions is None:
+        kvmin = jnp.broadcast_to(
+            (jnp.arange(nkv, dtype=jnp.int32) * bkv)[None, :], (B, nkv))
+    else:
+        kvmin = jnp.min(kv_positions.reshape(B, -1, bkv),
+                        axis=-1).astype(jnp.int32)
     imin = jnp.sum(qmax[:, :, None] < kvmin[:, None, :],
                    axis=1).astype(jnp.int32)
     return qmax, imin, kvmin
@@ -90,9 +96,13 @@ def _block_extents(q_positions, kv_positions, bq, bkv):
 # ---------------------------------------------------------------------------
 
 
-def _fwd_kernel(qmax_ref, imin_ref, kvmin_ref, qpos_ref, kvpos_ref,
-                q_ref, k_ref, v_ref, o_ref, lse_ref, m_sc, l_sc, acc_sc,
-                *, scale: float):
+def _fwd_kernel(qmax_ref, imin_ref, kvmin_ref, qpos_ref, *rest,
+                scale: float, use_kvpos: bool):
+    if use_kvpos:
+        (kvpos_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
+         m_sc, l_sc, acc_sc) = rest
+    else:
+        q_ref, k_ref, v_ref, o_ref, lse_ref, m_sc, l_sc, acc_sc = rest
     b, i, j = pl.program_id(0), pl.program_id(2), pl.program_id(3)
     nj = pl.num_programs(3)
 
@@ -104,15 +114,24 @@ def _fwd_kernel(qmax_ref, imin_ref, kvmin_ref, qpos_ref, kvpos_ref,
 
     @pl.when(kvmin_ref[b, j] <= qmax_ref[b, i])
     def _():
+        blk_q = q_ref.shape[2]
+        blk_kv = k_ref.shape[2]
         q = q_ref[0, 0, :, :].astype(jnp.float32) * scale        # [bq, D]
         qpos = qpos_ref[0, :, 0]
-        kvpos = kvpos_ref[0, 0, :]
+        if use_kvpos:
+            kvmat = kvpos_ref[0, 0, :][None, :]
+        else:
+            # standard causal path: slot == position, pure iota — no
+            # kvpos operand (whose lane-dim block would violate the
+            # Mosaic divisibility rule at odd cache lengths).
+            kvmat = j * blk_kv + jax.lax.broadcasted_iota(
+                jnp.int32, (blk_q, blk_kv), 1)
         k = k_ref[0, 0, :, :].astype(jnp.float32)                # [bkv, D]
         v = v_ref[0, 0, :, :].astype(jnp.float32)
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)                  # [bq, bkv]
-        s = jnp.where(kvpos[None, :] <= qpos[:, None], s, NEG_INF)
+        s = jnp.where(kvmat <= qpos[:, None], s, NEG_INF)
         m_prev, l_prev = m_sc[:, :], l_sc[:, :]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
         p = jnp.exp(s - m_new)
@@ -142,8 +161,10 @@ def _fwd(qt, kt, vt, qpos3, kvpos3, scale, blk_q, blk_kv,
     bq = _pick_block(Lq, blk_q)
     bkv = _pick_block(Lk, blk_kv)
     nq, nkv = Lq // bq, Lk // bkv
-    qmax, imin, kvmin = _block_extents(qpos3[:, :, 0], kvpos3[:, 0, :],
-                                       bq, bkv)
+    use_kvpos = kvpos3 is not None
+    qmax, imin, kvmin = _block_extents(
+        qpos3[:, :, 0], kvpos3[:, 0, :] if use_kvpos else None,
+        bq, bkv, nkv=nkv)
 
     if clamp:
         def kv_map(b, h, i, j, qmax, imin, kvmin, r=n_rep, bkv=bkv):
@@ -163,15 +184,16 @@ def _fwd(qt, kt, vt, qpos3, kvpos3, scale, blk_q, blk_kv,
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=3,
         grid=(B, H, nq, nkv),
-        in_specs=[
-            pl.BlockSpec((1, bq, 1),
-                         lambda b, h, i, j, qm, im, km: (b, i, 0)),
-            pl.BlockSpec((1, 1, bkv), kvpos_map),
-            pl.BlockSpec((1, 1, bq, D),
-                         lambda b, h, i, j, qm, im, km: (b, h, i, 0)),
-            pl.BlockSpec((1, 1, bkv, D), kv_map),
-            pl.BlockSpec((1, 1, bkv, D), kv_map),
-        ],
+        in_specs=(
+            [pl.BlockSpec((1, bq, 1),
+                          lambda b, h, i, j, qm, im, km: (b, i, 0))]
+            + ([pl.BlockSpec((1, 1, bkv), kvpos_map)] if use_kvpos
+               else [])
+            + [pl.BlockSpec((1, 1, bq, D),
+                            lambda b, h, i, j, qm, im, km: (b, h, i, 0)),
+               pl.BlockSpec((1, 1, bkv, D), kv_map),
+               pl.BlockSpec((1, 1, bkv, D), kv_map)]
+        ),
         out_specs=[
             pl.BlockSpec((1, 1, bq, D),
                          lambda b, h, i, j, qm, im, km: (b, h, i, 0)),
@@ -184,15 +206,20 @@ def _fwd(qt, kt, vt, qpos3, kvpos3, scale, blk_q, blk_kv,
             pltpu.VMEM((bq, D), jnp.float32),   # running accumulator
         ],
     )
+    operands = [qmax, imin, kvmin, qpos3]
+    if use_kvpos:
+        operands.append(kvpos3)
+    operands += [qt, kt, vt]
     out, lse = pl.pallas_call(
-        functools.partial(_fwd_kernel, scale=scale),
+        functools.partial(_fwd_kernel, scale=scale,
+                          use_kvpos=use_kvpos),
         grid_spec=grid_spec,
         out_shape=[
             jax.ShapeDtypeStruct(qt.shape, qt.dtype),
             jax.ShapeDtypeStruct((B, H, Lq, 1), jnp.float32),
         ],
         interpret=interpret_mode(),
-    )(qmax, imin, kvmin, qpos3, kvpos3, qt, kt, vt)
+    )(*operands)
     return out, lse
 
 
@@ -201,9 +228,14 @@ def _fwd(qt, kt, vt, qpos3, kvpos3, scale, blk_q, blk_kv,
 # ---------------------------------------------------------------------------
 
 
-def _dq_kernel(qmax_ref, imin_ref, kvmin_ref, qpos_ref, kvpos_ref,
-               q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-               dq_sc, *, scale: float):
+def _dq_kernel(qmax_ref, imin_ref, kvmin_ref, qpos_ref, *rest,
+               scale: float, use_kvpos: bool):
+    if use_kvpos:
+        (kvpos_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+         dq_ref, dq_sc) = rest
+    else:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+         dq_sc) = rest
     b, i, j = pl.program_id(0), pl.program_id(2), pl.program_id(3)
     nj = pl.num_programs(3)
 
@@ -217,15 +249,20 @@ def _dq_kernel(qmax_ref, imin_ref, kvmin_ref, qpos_ref, kvpos_ref,
         do = do_ref[0, 0, :, :].astype(jnp.float32)
         lse = lse_ref[0, 0, :, :]
         delta = delta_ref[0, 0, :, :]
+        blk_q = q_ref.shape[2]
+        blk_kv = k_ref.shape[2]
         qpos = qpos_ref[0, :, 0]
-        kvpos = kvpos_ref[0, 0, :]
+        if use_kvpos:
+            kvmat = kvpos_ref[0, 0, :][None, :]
+        else:
+            kvmat = j * blk_kv + jax.lax.broadcasted_iota(
+                jnp.int32, (blk_q, blk_kv), 1)
         k = k_ref[0, 0, :, :].astype(jnp.float32)
         v = v_ref[0, 0, :, :].astype(jnp.float32)
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
-        p = jnp.where(kvpos[None, :] <= qpos[:, None],
-                      jnp.exp(s - lse), 0.0)
+        p = jnp.where(kvmat <= qpos[:, None], jnp.exp(s - lse), 0.0)
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -238,9 +275,14 @@ def _dq_kernel(qmax_ref, imin_ref, kvmin_ref, qpos_ref, kvpos_ref,
         dq_ref[0, 0, :, :] = (dq_sc[:, :] * scale).astype(dq_ref.dtype)
 
 
-def _dkv_kernel(qmax_ref, imin_ref, kvmin_ref, qpos_ref, kvpos_ref,
-                q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                dk_ref, dv_ref, dk_sc, dv_sc, *, scale: float):
+def _dkv_kernel(qmax_ref, imin_ref, kvmin_ref, qpos_ref, *rest,
+                scale: float, use_kvpos: bool):
+    if use_kvpos:
+        (kvpos_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+         dk_ref, dv_ref, dk_sc, dv_sc) = rest
+    else:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref,
+         dv_ref, dk_sc, dv_sc) = rest
     b, j, i = pl.program_id(0), pl.program_id(2), pl.program_id(3)
     ni = pl.num_programs(3)
 
@@ -255,15 +297,20 @@ def _dkv_kernel(qmax_ref, imin_ref, kvmin_ref, qpos_ref, kvpos_ref,
         do = do_ref[0, 0, :, :].astype(jnp.float32)
         lse = lse_ref[0, 0, :, :]
         delta = delta_ref[0, 0, :, :]
+        blk_q = q_ref.shape[2]
+        blk_kv = k_ref.shape[2]
         qpos = qpos_ref[0, :, 0]
-        kvpos = kvpos_ref[0, 0, :]
+        if use_kvpos:
+            kvmat = kvpos_ref[0, 0, :][None, :]
+        else:
+            kvmat = j * blk_kv + jax.lax.broadcasted_iota(
+                jnp.int32, (blk_q, blk_kv), 1)
         k = k_ref[0, 0, :, :].astype(jnp.float32)
         v = v_ref[0, 0, :, :].astype(jnp.float32)
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)               # [bq, bkv]
-        p = jnp.where(kvpos[None, :] <= qpos[:, None],
-                      jnp.exp(s - lse), 0.0)
+        p = jnp.where(kvmat <= qpos[:, None], jnp.exp(s - lse), 0.0)
         dv_sc[:, :] = dv_sc[:, :] + jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)               # [bkv, D]
@@ -289,8 +336,10 @@ def _dq_call(qt, kt, vt, qpos3, kvpos3, dout_t, lse, delta, scale,
     bq = _pick_block(Lq, blk_q)
     bkv = _pick_block(Lk, blk_kv)
     nq, nkv = Lq // bq, Lk // bkv
-    qmax, imin, kvmin = _block_extents(qpos3[:, :, 0], kvpos3[:, 0, :],
-                                       bq, bkv)
+    use_kvpos = kvpos3 is not None
+    qmax, imin, kvmin = _block_extents(
+        qpos3[:, :, 0], kvpos3[:, 0, :] if use_kvpos else None,
+        bq, bkv, nkv=nkv)
 
     if clamp:
         def kv_map(b, h, i, j, qm, im, km, r=n_rep, bkv=bkv):
@@ -309,28 +358,32 @@ def _dq_call(qt, kt, vt, qpos3, kvpos3, dout_t, lse, delta, scale,
                           lambda b, h, i, j, qm, im, km: (b, h, i, 0))
     row_spec = pl.BlockSpec((1, 1, bq, 1),
                             lambda b, h, i, j, qm, im, km: (b, h, i, 0))
+    in_specs = (
+        [pl.BlockSpec((1, bq, 1),
+                      lambda b, h, i, j, qm, im, km: (b, i, 0))]
+        + ([pl.BlockSpec((1, 1, bkv), kvpos_map)] if use_kvpos else [])
+        + [q_spec,
+           pl.BlockSpec((1, 1, bkv, D), kv_map),
+           pl.BlockSpec((1, 1, bkv, D), kv_map),
+           q_spec, row_spec, row_spec]
+    )
+    operands = [qmax, imin, kvmin, qpos3]
+    if use_kvpos:
+        operands.append(kvpos3)
+    operands += [qt, kt, vt, dout_t, lse, delta]
     return pl.pallas_call(
-        functools.partial(_dq_kernel, scale=scale),
+        functools.partial(_dq_kernel, scale=scale,
+                          use_kvpos=use_kvpos),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=3,
             grid=(B, H, nq, nkv),
-            in_specs=[
-                pl.BlockSpec((1, bq, 1),
-                             lambda b, h, i, j, qm, im, km: (b, i, 0)),
-                pl.BlockSpec((1, 1, bkv), kvpos_map),
-                q_spec,
-                pl.BlockSpec((1, 1, bkv, D), kv_map),
-                pl.BlockSpec((1, 1, bkv, D), kv_map),
-                q_spec,
-                row_spec,
-                row_spec,
-            ],
+            in_specs=in_specs,
             out_specs=q_spec,
             scratch_shapes=[pltpu.VMEM((bq, D), jnp.float32)],
         ),
         out_shape=jax.ShapeDtypeStruct(qt.shape, qt.dtype),
         interpret=interpret_mode(),
-    )(qmax, imin, kvmin, qpos3, kvpos3, qt, kt, vt, dout_t, lse, delta)
+    )(*operands)
 
 
 def _dkv_call(qt, kt, vt, qpos3, kvpos3, dout_t, lse, delta, scale,
@@ -342,8 +395,10 @@ def _dkv_call(qt, kt, vt, qpos3, kvpos3, dout_t, lse, delta, scale,
     bq = _pick_block(Lq, blk_q)
     bkv = _pick_block(Lk, blk_kv)
     nq, nkv = Lq // bq, Lk // bkv
-    qmax, imin, kvmin = _block_extents(qpos3[:, :, 0], kvpos3[:, 0, :],
-                                       bq, bkv)
+    use_kvpos = kvpos3 is not None
+    qmax, imin, kvmin = _block_extents(
+        qpos3[:, :, 0], kvpos3[:, 0, :] if use_kvpos else None,
+        bq, bkv, nkv=nkv)
 
     if clamp:
         def q_map(b, h, j, i, qm, im, km):
@@ -368,26 +423,33 @@ def _dkv_call(qt, kt, vt, qpos3, kvpos3, dout_t, lse, delta, scale,
 
     kv_out_spec = pl.BlockSpec((1, 1, bkv, D),
                                lambda b, h, j, i, qm, im, km: (b, h, j, 0))
+    in_specs = (
+        [pl.BlockSpec((1, bq, 1), qpos_map)]
+        + ([pl.BlockSpec((1, 1, bkv),
+                         lambda b, h, j, i, qm, im, km: (b, 0, j))]
+           if use_kvpos else [])
+        + [pl.BlockSpec((1, 1, bq, D), q_map),
+           pl.BlockSpec((1, 1, bkv, D),
+                        lambda b, h, j, i, qm, im, km, r=n_rep:
+                        (b, h // r, j, 0)),
+           pl.BlockSpec((1, 1, bkv, D),
+                        lambda b, h, j, i, qm, im, km, r=n_rep:
+                        (b, h // r, j, 0)),
+           pl.BlockSpec((1, 1, bq, D), q_map),
+           pl.BlockSpec((1, 1, bq, 1), q_row_map),
+           pl.BlockSpec((1, 1, bq, 1), q_row_map)]
+    )
+    operands = [qmax, imin, kvmin, qpos3]
+    if use_kvpos:
+        operands.append(kvpos3)
+    operands += [qt, kt, vt, dout_t, lse, delta]
     dk_h, dv_h = pl.pallas_call(
-        functools.partial(_dkv_kernel, scale=scale),
+        functools.partial(_dkv_kernel, scale=scale,
+                          use_kvpos=use_kvpos),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=3,
             grid=(B, H, nkv, nq),
-            in_specs=[
-                pl.BlockSpec((1, bq, 1), qpos_map),
-                pl.BlockSpec((1, 1, bkv),
-                             lambda b, h, j, i, qm, im, km: (b, 0, j)),
-                pl.BlockSpec((1, 1, bq, D), q_map),
-                pl.BlockSpec((1, 1, bkv, D),
-                             lambda b, h, j, i, qm, im, km, r=n_rep:
-                             (b, h // r, j, 0)),
-                pl.BlockSpec((1, 1, bkv, D),
-                             lambda b, h, j, i, qm, im, km, r=n_rep:
-                             (b, h // r, j, 0)),
-                pl.BlockSpec((1, 1, bq, D), q_map),
-                pl.BlockSpec((1, 1, bq, 1), q_row_map),
-                pl.BlockSpec((1, 1, bq, 1), q_row_map),
-            ],
+            in_specs=in_specs,
             out_specs=[kv_out_spec, kv_out_spec],
             scratch_shapes=[
                 pltpu.VMEM((bkv, D), jnp.float32),
@@ -399,7 +461,7 @@ def _dkv_call(qt, kt, vt, qpos3, kvpos3, dout_t, lse, delta, scale,
             jax.ShapeDtypeStruct((B, H, Lk, D), jnp.float32),
         ],
         interpret=interpret_mode(),
-    )(qmax, imin, kvmin, qpos3, kvpos3, qt, kt, vt, dout_t, lse, delta)
+    )(*operands)
     return dk_h, dv_h
 
 
@@ -428,9 +490,29 @@ def _bwd_impl(qt, kt, vt, qpos3, kvpos3, scale, blk_q, blk_kv, out_t,
 # ---------------------------------------------------------------------------
 
 
-def _arange_kvpos(B, Lk):
-    return jnp.broadcast_to(jnp.arange(Lk, dtype=jnp.int32)[None, :],
-                            (B, Lk))
+def _check_chunk_alignment(Lq: int, Lk: int, blk_q: int,
+                           blk_kv: int) -> None:
+    """Ring chunks feed the explicit-kv-positions kernel variant; on
+    real TPU its blocks must satisfy Mosaic's lane/sublane rules:
+    the kv-position block's lane dim (bkv) must be a multiple of 128
+    or equal the full Lk, and the q block's sublane dim (bq) a
+    multiple of 8 or equal the full Lq.  The standard causal path has
+    no kv-position operand and no such constraint."""
+    if interpret_mode():
+        return
+    bkv = _pick_block(Lk, blk_kv)
+    if bkv % 128 and bkv != Lk:
+        raise ValueError(
+            f"ring-chunk kv length {Lk} tiles into lane blocks of "
+            f"{bkv} on TPU, violating the Mosaic 128-lane rule; use a "
+            "chunk length that is a multiple of 128 (or a power of two "
+            "<= 512)")
+    bq = _pick_block(Lq, blk_q)
+    if bq % 8 and bq != Lq:
+        raise ValueError(
+            f"ring-chunk query length {Lq} tiles into sublane blocks "
+            f"of {bq} on TPU, violating the Mosaic 8-sublane rule; use "
+            "a chunk length that is a multiple of 8")
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
@@ -447,30 +529,25 @@ def flash_attention_gqa(q, k, v, q_positions, scale,
     to the reference attention mask built in models/transformer.py).
     Returns [B, Lq, H, D] in q.dtype.
     """
-    B, Lk = k.shape[0], k.shape[1]
     out, _ = _fwd(q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
                   v.transpose(0, 2, 1, 3), q_positions[:, :, None],
-                  _arange_kvpos(B, Lk)[:, None, :],
-                  scale, blk_q, blk_kv, clamp=True)
+                  None, scale, blk_q, blk_kv, clamp=True)
     return out.transpose(0, 2, 1, 3)
 
 
 def _vjp_fwd(q, k, v, q_positions, scale, blk_q, blk_kv):
-    B, Lk = k.shape[0], k.shape[1]
     qt = q.transpose(0, 2, 1, 3)
     kt = k.transpose(0, 2, 1, 3)
     vt = v.transpose(0, 2, 1, 3)
     qpos3 = q_positions[:, :, None]
-    kvpos3 = _arange_kvpos(B, Lk)[:, None, :]
-    out_t, lse = _fwd(qt, kt, vt, qpos3, kvpos3, scale, blk_q, blk_kv,
+    out_t, lse = _fwd(qt, kt, vt, qpos3, None, scale, blk_q, blk_kv,
                       clamp=True)
-    return out_t.transpose(0, 2, 1, 3), (qt, kt, vt, qpos3, kvpos3,
-                                         out_t, lse)
+    return out_t.transpose(0, 2, 1, 3), (qt, kt, vt, qpos3, out_t, lse)
 
 
 def _vjp_bwd(scale, blk_q, blk_kv, residuals, dout):
-    qt, kt, vt, qpos3, kvpos3, out_t, lse = residuals
-    dq, dk, dv = _bwd_impl(qt, kt, vt, qpos3, kvpos3, scale, blk_q,
+    qt, kt, vt, qpos3, out_t, lse = residuals
+    dq, dk, dv = _bwd_impl(qt, kt, vt, qpos3, None, scale, blk_q,
                            blk_kv, out_t, lse, dout.transpose(0, 2, 1, 3),
                            clamp=True)
     return (dq.transpose(0, 2, 1, 3),
@@ -494,6 +571,7 @@ def flash_chunk_fwd(q, k, v, q_positions, kv_positions, scale,
     [B, Lk] are arbitrary absolute positions (rotated zigzag chunks);
     fully-masked rows give out = 0, lse ≈ -inf.  No VJP — the ring
     caller owns the backward (flash_chunk_grads with the global lse)."""
+    _check_chunk_alignment(q.shape[1], k.shape[1], blk_q, blk_kv)
     out_t, lse = _fwd(q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
                       v.transpose(0, 2, 1, 3), q_positions[:, :, None],
                       kv_positions[:, None, :], scale, blk_q, blk_kv,
@@ -509,6 +587,7 @@ def flash_chunk_grads(q, k, v, q_positions, kv_positions, out, lse,
     reconstructs this chunk's exact global attention weights, so the
     returned (dq_partial, dk, dv) are exact per-chunk contributions
     (dq sums over chunks; dk/dv are complete for this chunk's KV)."""
+    _check_chunk_alignment(q.shape[1], k.shape[1], blk_q, blk_kv)
     qt = q.transpose(0, 2, 1, 3)
     kt = k.transpose(0, 2, 1, 3)
     vt = v.transpose(0, 2, 1, 3)

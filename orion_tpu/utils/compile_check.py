@@ -1,0 +1,124 @@
+"""AOT compile checks for shapes one chip can't train (SURVEY.md §6:
+the 8B leg of the BASELINE metric).  Tracing/lowering allocates no
+model buffers, so the FULL llama3_8b shared-trunk PPO update step can
+be verified to build — single-device (bench.py) or sharded over a mesh
+with the real fsdp/tensor layouts (dryrun_multichip, where .compile()
+also runs the SPMD partitioner and checks collective legality).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def _build_8b_shell():
+    """(shell, state_shapes, minibatch_shapes): an abstract 8B
+    shared-backbone PPO trainer — every attribute its jitted update
+    touches, with ShapeDtypeStruct params (no buffers)."""
+    import flax.linen as nn
+
+    from orion_tpu.config import ModelConfig, OptimizerConfig, PPOConfig
+    from orion_tpu.models.heads import ActorCriticModel
+    from orion_tpu.trainers.base import BaseTrainer, make_optimizer
+    from orion_tpu.trainers.ppo import PPOTrainer
+
+    cfg = PPOConfig()
+    cfg.model = ModelConfig.llama3_8b()
+    cfg.model.remat = True
+    cfg.model.scan_layers = True
+    cfg.share_backbone = True
+    cfg.optimizer = OptimizerConfig(
+        learning_rate=1e-6, mu_dtype="bfloat16", nu_dtype="bfloat16")
+    cfg.minibatch_size = 1
+    cfg.rollout.max_prompt_len = 256
+    cfg.rollout.max_new_tokens = 128
+
+    model = ActorCriticModel(cfg.model)
+    pshape = jax.eval_shape(
+        lambda k: model.init(k, jnp.zeros((1, 2), jnp.int32),
+                             jnp.zeros((1, 2), jnp.int32))["params"],
+        jax.random.key(0))
+    pshape = jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype),
+        nn.meta.unbox(pshape))
+    tx = make_optimizer(cfg.optimizer)
+
+    class _Shell:
+        pass
+
+    shell = _Shell()
+    shell.cfg = cfg
+    shell.model = model
+    shell.tx = tx
+    shell.loss_fn = lambda p, m: PPOTrainer.loss_fn(shell, p, m)
+    shell._policy_apply = \
+        lambda *a, **k: BaseTrainer._policy_apply(shell, *a, **k)
+    shell._lp_values_fwd = \
+        lambda *a, **k: PPOTrainer._lp_values_fwd(shell, *a, **k)
+    shell._gather_completion = PPOTrainer._gather_completion
+
+    B = cfg.minibatch_size
+    T = cfg.rollout.max_new_tokens
+    seq = cfg.rollout.max_prompt_len + T
+    mb = {
+        "sequences": jax.ShapeDtypeStruct((B, seq), jnp.int32),
+        "prompt_lens": jax.ShapeDtypeStruct((B,), jnp.int32),
+        "mask": jax.ShapeDtypeStruct((B, T), jnp.float32),
+        "old_logprobs": jax.ShapeDtypeStruct((B, T), jnp.float32),
+        "old_values": jax.ShapeDtypeStruct((B, T), jnp.float32),
+        "advantages": jax.ShapeDtypeStruct((B, T), jnp.float32),
+        "returns": jax.ShapeDtypeStruct((B, T), jnp.float32),
+    }
+    return shell, pshape, mb
+
+
+def _abstract_state(shell, pshape):
+    from orion_tpu.trainers.base import TrainState
+
+    opt_shape = jax.eval_shape(shell.tx.init, pshape)
+    return TrainState(params=pshape, opt_state=opt_shape,
+                      step=jax.ShapeDtypeStruct((), jnp.int32))
+
+
+def lower_8b_update(mesh=None, compile: bool = False) -> str:
+    """Trace + lower (and optionally compile) the full 8B update step.
+
+    mesh=None: single-device shapes (bench.py's compile check).  With a
+    mesh: params carry the real fsdp/tensor NamedShardings and
+    ``compile=True`` runs the SPMD partitioner over it.  Returns a
+    short status string.
+    """
+    from orion_tpu.trainers.base import BaseTrainer
+
+    t0 = time.perf_counter()
+    shell, pshape, mb = _build_8b_shell()
+    if mesh is not None:
+        from orion_tpu.models.sharded import mesh_shardings_for
+
+        init_args = (jnp.zeros((1, 2), jnp.int32),
+                     jnp.zeros((1, 2), jnp.int32))
+        shardings = mesh_shardings_for(shell.model, mesh, init_args)
+        pshape = jax.tree.map(
+            lambda x, s: jax.ShapeDtypeStruct(x.shape, x.dtype,
+                                              sharding=s),
+            pshape, shardings)
+    state = _abstract_state(shell, pshape)
+    B = shell.cfg.minibatch_size
+
+    def update(state, mb):
+        idx = jnp.arange(B)
+        return BaseTrainer._update_fn(shell, state, mb, idx)
+
+    lowered = jax.jit(update).lower(state, mb)
+    if compile:
+        lowered.compile()
+    n = sum(int(jnp.prod(jnp.asarray(x.shape)))
+            for x in jax.tree.leaves(pshape))
+    dt = time.perf_counter() - t0
+    verb = "compiled" if compile else "lowered"
+    where = f"on {dict(mesh.shape)}" if mesh is not None else "1-device"
+    return f"ok ({n/1e9:.2f}B params {verb} {where} in {dt:.0f}s)"
