@@ -121,3 +121,35 @@ def test_shared_ppo_checkpoint_resume(tmp_path):
     assert tr2.global_iter == 2
     leaf2 = np.asarray(jax.tree.leaves(tr2.state.params)[0])
     np.testing.assert_array_equal(leaf, leaf2)
+
+
+def test_shared_ppo_async_mode():
+    """Decoupled rollout/learner with the shared trunk: PPO's async
+    experience branch (values from the learner's _jit_values, behavior
+    logprobs from the engine's sampling distribution)."""
+    import jax.numpy as jnp
+
+    from orion_tpu.config import MeshConfig
+    from orion_tpu.models.sharded import make_sharded_model
+    from orion_tpu.orchestration import AsyncOrchestrator, split_devices
+    from orion_tpu.parallel.mesh import make_mesh
+
+    cfg = _mk(PPOConfig, kl_coef=0.0, num_epochs=1, vf_coef=0.05,
+              share_backbone=True, async_mode=True, async_staleness=1,
+              rollout_batch_size=8, minibatch_size=8,
+              optimizer=OptimizerConfig(learning_rate=5e-3,
+                                        grad_clip=1.0))
+    rollout_devs, train_devs = split_devices(jax.devices(), 4)
+    mesh = make_mesh(MeshConfig(data=1, fsdp=-1, seq=1, tensor=1),
+                     devices=train_devs)
+    model = ActorCriticModel(cfg.model)
+    init_args = (jnp.zeros((1, 2), jnp.int32), jnp.zeros((1, 2), jnp.int32))
+    params, _ = make_sharded_model(model, mesh, jax.random.key(0),
+                                   init_args)
+    tr = PPOTrainer(cfg, model, params, reward_fn=lucky_token_reward)
+    orch = AsyncOrchestrator(tr, rollout_devs)
+    history = orch.train(prompt_stream(8, 5), num_iterations=4)
+    assert len(history) == 4
+    for h in history:
+        assert np.isfinite(h["loss"])
+        assert 0 <= h["staleness"] <= 1
