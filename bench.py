@@ -69,6 +69,12 @@ def _preset():
             learning_rate=1e-6, mu_dtype="bfloat16", nu_dtype="bfloat16")
         cfg.rollout.max_prompt_len = 256
         cfg.rollout.max_new_tokens = 128
+        # int8 decode (weights + KV cache): decode is bandwidth-bound
+        # once the scatter cache write landed; measured r3 on-chip:
+        # 5.13 -> 3.06 ms/step (see PERF.md).  Training math is
+        # unaffected (old-logprobs recomputed under the training graph).
+        cfg.rollout.quantize_weights = True
+        cfg.rollout.quantize_kv = True
         cfg.rollout_batch_size = 32
         # mb sweep on-chip: 4 -> 1161 ms, 8 -> 960, 16 -> 875, 32 OOM.
         cfg.minibatch_size = 16
@@ -175,7 +181,11 @@ def main() -> None:
     # logprob recompute, update); measured iterations reuse the cache.
     trainer.train(iter([batch()]), num_iterations=1)
 
-    iters = int(os.environ.get("ORION_BENCH_ITERS", "3"))
+    # 6 iterations: the r3 deferred-stats pipeline overlaps iteration
+    # i's update with i+1's generation, so the last iteration always
+    # pays an un-overlapped flush — more iterations = closer to the
+    # steady-state rate a real run sees.
+    iters = int(os.environ.get("ORION_BENCH_ITERS", "6"))
     prof_dir = os.environ.get("ORION_BENCH_PROFILE")
     if prof_dir:
         jax.profiler.start_trace(prof_dir)

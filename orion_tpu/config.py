@@ -49,6 +49,10 @@ class ModelConfig:
     remat: bool = False  # jax.checkpoint each block (HBM <-> FLOPs trade)
     attention_impl: str = "auto"  # "auto" | "reference" | "flash" | "ring"
     scan_layers: bool = False  # lax.scan over stacked layers (compile-time win)
+    # Dense layers read int8 kernels (QuantDense layout — see
+    # ops/quant.py).  Set only on the rollout engines' decode twin when
+    # RolloutConfig.quantize_weights is on; never on a training model.
+    quantize_dense: bool = False
     # Megatron-style sequence parallelism: residual-stream activations
     # between blocks sharded on seq over the TENSOR axis (GSPMD emits
     # the megatron AG/RS pattern; norms compute on L/tp tokens).  See
@@ -225,6 +229,15 @@ class RolloutConfig:
     max_batch_size: int = 32
     segment_len: int = 16
     logprobs_dtype: str = "float32"  # f32 softmax to avoid bf16 drift
+    # int8 decode (ops/quant.py): decode is HBM-bound, so storing the
+    # decode twin's Dense kernels int8 (weight-only, per-out-channel
+    # scales, convert fused into the dot — measured 1.76x on the matmul
+    # stack) and/or the dense KV cache int8 (per-token-per-head scales)
+    # moves the bandwidth floor itself.  Opt-in: off by default so
+    # parity tests see the exact policy; the bench turns both on.  The
+    # training graph is never quantized.
+    quantize_weights: bool = False
+    quantize_kv: bool = False
 
 
 @dataclass

@@ -42,6 +42,36 @@ def completion_logprobs(logits: jnp.ndarray, sequences: jnp.ndarray,
     return jnp.take_along_axis(all_lp, idx, axis=1)
 
 
+def completion_window_positions(prompt_lens: jnp.ndarray,
+                                max_new_tokens: int,
+                                seq_len: int) -> jnp.ndarray:
+    """Logit positions that predict the completion tokens: completion
+    token j (abs index prompt_len+j) is predicted by the logits at
+    prompt_len+j-1.  Returns [B, T] indices into the sequence axis.
+
+    Passing these as ``Transformer(..., logits_positions=...)`` computes
+    the vocab projection ONLY at these T positions instead of all L —
+    at ppo1b shapes that cuts the biggest matmul in the model (and its
+    [B, L, V] f32 logits, 2.5 GB at L=384) to the T=128 completion
+    window, in both the experience pass and the update fwd+bwd."""
+    idx = prompt_lens[:, None] + jnp.arange(max_new_tokens)[None, :] - 1
+    return jnp.clip(idx, 0, seq_len - 1)
+
+
+def windowed_completion_logprobs(logits_w: jnp.ndarray,
+                                 sequences: jnp.ndarray,
+                                 prompt_lens: jnp.ndarray,
+                                 max_new_tokens: int) -> jnp.ndarray:
+    """Per-completion-token logprobs from windowed logits ([B, T, V]
+    taken at ``completion_window_positions``).  Numerically identical to
+    ``completion_logprobs`` on the full logits (tested)."""
+    logps = jax.nn.log_softmax(logits_w.astype(jnp.float32), axis=-1)
+    tgt = prompt_lens[:, None] + jnp.arange(max_new_tokens)[None, :]
+    tgt = jnp.clip(tgt, 0, sequences.shape[1] - 1)
+    targets = jnp.take_along_axis(sequences, tgt, axis=1)
+    return jnp.take_along_axis(logps, targets[..., None], axis=-1)[..., 0]
+
+
 def entropy_from_logits(logits: jnp.ndarray) -> jnp.ndarray:
     """Per-position entropy, f32: [B, L, V] → [B, L]."""
     logits = logits.astype(jnp.float32)

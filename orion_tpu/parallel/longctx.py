@@ -51,7 +51,7 @@ _NEG_INF = -1e30
 
 def ulysses_attention(q, k, v, q_positions, scale: float,
                       axis_name: str = "seq",
-                      impl: str = "reference") -> jnp.ndarray:
+                      impl: str = "auto") -> jnp.ndarray:
     """Call inside shard_map with the sequence axis mapped.
 
     q [B, Ls, H, D], k/v [B, Ls, Hkv, D], q_positions [B, Ls] — all
@@ -193,16 +193,19 @@ def _ring_vjp_bwd(scale, axis_name, residuals, dout):
     perm = [(i, (i + 1) % s) for i in range(s)]
     glse_t = glse.transpose(0, 2, 1)                      # [B, H, Lq]
 
-    dq = jnp.zeros_like(q)
+    # f32 accumulators: flash_chunk_grads returns per-chunk grads in the
+    # compute dtype; summing s ring contributions at bf16 loses mantissa
+    # every step (ADVICE r2).  Accumulate f32, cast once on return.
+    dq = jnp.zeros(q.shape, jnp.float32)
     k_r, v_r, kvp_r = k, v, kv_positions
-    dk_r = jnp.zeros_like(k)
-    dv_r = jnp.zeros_like(v)
+    dk_r = jnp.zeros(k.shape, jnp.float32)
+    dv_r = jnp.zeros(v.shape, jnp.float32)
     for step in range(s):
         dq_i, dk_i, dv_i = flash_chunk_grads(
             q, k_r, v_r, q_positions, kvp_r, out, glse_t, dout, scale)
-        dq = dq + dq_i
-        dk_r = dk_r + dk_i
-        dv_r = dv_r + dv_i
+        dq = dq + dq_i.astype(jnp.float32)
+        dk_r = dk_r + dk_i.astype(jnp.float32)
+        dv_r = dv_r + dv_i.astype(jnp.float32)
         # dk/dv accumulators travel WITH their chunks and need the full
         # s rotations to arrive home; k/v/kvpos are only consumed by
         # the next step's compute, so their final rotation is skipped
@@ -213,7 +216,8 @@ def _ring_vjp_bwd(scale, axis_name, residuals, dout):
             kvp_r = lax.ppermute(kvp_r, axis_name, perm)
         dk_r = lax.ppermute(dk_r, axis_name, perm)
         dv_r = lax.ppermute(dv_r, axis_name, perm)
-    return dq, dk_r, dv_r, None, None
+    return (dq.astype(q.dtype), dk_r.astype(k.dtype),
+            dv_r.astype(v.dtype), None, None)
 
 
 ring_attention.defvjp(_ring_vjp_fwd, _ring_vjp_bwd)

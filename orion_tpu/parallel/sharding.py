@@ -72,6 +72,26 @@ def shard_params(params: Any, logical_axes: Any, mesh: Mesh,
     return jax.device_put(params, shardings)
 
 
+# Resolve the ambient-mesh accessor ONCE at import: thread_resources is
+# a private jax API, and a jax upgrade that moves it must fail loudly at
+# import of this module — not silently disable Megatron-SP in a deployed
+# run, losing its memory/comm savings with no signal (ADVICE r2).
+try:
+    from jax._src import mesh as _mesh_lib
+
+    _mesh_lib.thread_resources.env.physical_mesh  # probe the attribute path
+except (ImportError, AttributeError) as _e:  # pragma: no cover - jax upgrade
+    raise ImportError(
+        "orion_tpu.parallel.sharding: jax moved the private "
+        "thread_resources API this module uses to resolve the ambient "
+        "mesh for Megatron-SP activation sharding; update "
+        "constrain_seq_activation for this jax version") from _e
+
+
+def _ambient_mesh():
+    return _mesh_lib.thread_resources.env.physical_mesh
+
+
 def constrain_seq_activation(x):
     """Megatron-style sequence parallelism (SURVEY.md §2 parallelism
     table, row SP): constrain a [B, L, E] residual-stream activation to
@@ -86,16 +106,7 @@ def constrain_seq_activation(x):
     is 1, or L is indivisible/degenerate (decode steps) — so it is safe
     to leave in the model unconditionally behind the config flag.
     """
-    try:
-        from jax._src import mesh as mesh_lib
-
-        m = mesh_lib.thread_resources.env.physical_mesh
-    except (ImportError, AttributeError):
-        # Private-API guard only (jax moved thread_resources): fall back
-        # to unconstrained rather than breaking every forward — but the
-        # SP tests assert real sharding, so a silent regression here
-        # fails CI loudly.
-        return x
+    m = _ambient_mesh()
     if m is None or m.empty:
         return x
     tp = dict(m.shape).get("tensor", 1)

@@ -59,7 +59,18 @@ class ContinuousBatchingEngine:
         # All applies go through the (possibly unrolled-twin) decode
         # model; the scan-layout original is deliberately NOT kept —
         # the per-layer pools below match the unrolled cache layout.
-        self._decode_model, _ = make_decode_twin(model, model_cfg)
+        self._decode_model, dcfg = make_decode_twin(model, model_cfg)
+        if cfg.quantize_kv:
+            raise ValueError(
+                "quantize_kv covers the RolloutEngine dense cache only; "
+                "the continuous engine's paged pools read bf16 pages "
+                "(set quantize_kv=False for engine='continuous')")
+        if cfg.quantize_weights:
+            import dataclasses as _dc
+
+            dcfg = _dc.replace(dcfg, quantize_dense=True)
+            self._decode_model = type(self._decode_model)(dcfg)
+        self._quantize_weights = cfg.quantize_weights
         self.slots = cfg.max_batch_size
         ps = cfg.page_size
         self.pages_per_seq = -(-(cfg.max_prompt_len + cfg.max_new_tokens)
@@ -96,11 +107,34 @@ class ContinuousBatchingEngine:
             lambda x: x.astype(cdt)
             if jnp.issubdtype(x.dtype, jnp.floating) else x, params)
 
+    def _prep_params(self, params):
+        """Compute-dtype cast (+ unstack + int8 quantization when
+        enabled) as ONE jitted program.  The transforms are idempotent
+        — the per-call copies inside _prefill_fn/_segment_fn see an
+        already-processed tree and pass it through — so generate(...,
+        params=raw_tree) overrides still work."""
+        if not hasattr(self, "_jit_prep"):
+            from orion_tpu.models.transformer import \
+                maybe_unstack_for_decode
+
+            def prep(p):
+                p = self._compute_cast(p)
+                p = maybe_unstack_for_decode(p, self.mc)
+                if self._quantize_weights:
+                    from orion_tpu.ops.quant import quantize_params_int8
+
+                    p = quantize_params_int8(p)
+                return p
+
+            self._jit_prep = jax.jit(prep)
+        return self._jit_prep(params)
+
     def load_weights(self, params) -> None:
         """Install policy weights (same contract as RolloutEngine):
         the f32 master tree is cast to the compute dtype ONCE here, so
-        every decode step reads 2 bytes/param instead of 4."""
-        self._params = self._compute_cast(params)
+        every decode step reads 2 bytes/param instead of 4 (int8 when
+        quantize_weights is on)."""
+        self._params = self._prep_params(params)
 
     @staticmethod
     def _bucket(n: int, cap: int) -> int:
@@ -137,10 +171,12 @@ class ContinuousBatchingEngine:
         params = maybe_unstack_for_decode(params, self.mc)
         positions = jnp.broadcast_to(jnp.arange(P, dtype=jnp.int32), (B, P))
         cache = self._cache(pools, bt_rows)
+        # Vocab projection only at the last real prompt token (its
+        # logits predict completion[0]) — see RolloutEngine prefill.
         logits, cache = self._decode_model.apply(
-            {"params": params}, prompt_ids, positions, cache)
-        last = jnp.take_along_axis(
-            logits, (prompt_lens - 1)[:, None, None], axis=1)[:, 0]
+            {"params": params}, prompt_ids, positions, cache,
+            logits_positions=(prompt_lens - 1)[:, None])
+        last = logits[:, 0]
         tok0, lp0, plp0 = sample_tokens(
             rng, last, temperature=self.cfg.temperature,
             top_k=self.cfg.top_k, top_p=self.cfg.top_p)
@@ -200,7 +236,7 @@ class ContinuousBatchingEngine:
 
         requests: iterable of (req_id, prompt_ids 1-D int array).
         """
-        params = (self._compute_cast(params) if params is not None
+        params = (self._prep_params(params) if params is not None
                   else self._params)
         if params is None:
             raise ValueError("no weights loaded: call load_weights() first")

@@ -76,6 +76,18 @@ class RolloutEngine:
 
         self._decode_model, self._decode_cfg = make_decode_twin(
             model, model_cfg)
+        if cfg.quantize_kv and cfg.paged:
+            raise ValueError(
+                "quantize_kv currently covers the dense cache only; "
+                "the paged Pallas kernel reads bf16 pages "
+                "(use paged=False or quantize_kv=False)")
+        if cfg.quantize_weights:
+            # int8 decode twin (ops/quant.py): same architecture, Dense
+            # layers read int8 kernels.  Params are quantized inside
+            # _generate (once per call, amortized over every step).
+            self._decode_cfg = dataclasses.replace(
+                self._decode_cfg, quantize_dense=True)
+            self._decode_model = type(self._decode_model)(self._decode_cfg)
         self._generate_jit = jax.jit(
             self._generate, static_argnames=("max_new_tokens",))
 
@@ -122,6 +134,10 @@ class RolloutEngine:
         from orion_tpu.models.transformer import maybe_unstack_for_decode
 
         params = maybe_unstack_for_decode(params, self.model_cfg)
+        if cfg.quantize_weights:
+            from orion_tpu.ops.quant import quantize_params_int8
+
+            params = quantize_params_int8(params)
 
         if cfg.paged:
             from orion_tpu.ops.paged_kv import init_paged_cache
@@ -133,15 +149,18 @@ class RolloutEngine:
                 dtype=jnp.dtype(mc.dtype), stacked=mc.scan_layers)
         else:
             cache = init_cache(self._decode_cfg, B, P + T,
-                               dtype=jnp.dtype(self._decode_cfg.dtype))
+                               dtype=jnp.dtype(self._decode_cfg.dtype),
+                               quantized=cfg.quantize_kv)
         positions = jnp.broadcast_to(jnp.arange(P, dtype=jnp.int32), (B, P))
         with jax.named_scope("prefill"):
+            # Only the last real prompt token's logits are needed (they
+            # predict completion[0]) — logits_positions skips the other
+            # P-1 rows of the vocab projection and the [B, P, V] f32
+            # logits buffer (1.6 GB at ppo1b shapes).
             logits, cache = self._decode_model.apply(
-                {"params": params}, prompt_ids, positions, cache)
-
-        # logits at the last real prompt token predict completion[0]
-        last = jnp.take_along_axis(
-            logits, (prompt_lens - 1)[:, None, None], axis=1)[:, 0]
+                {"params": params}, prompt_ids, positions, cache,
+                logits_positions=(prompt_lens - 1)[:, None])
+        last = logits[:, 0]
         rng, sub = jax.random.split(rng)
         tok0, lp0, plp0 = sample(sub, last)
 

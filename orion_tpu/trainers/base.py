@@ -171,6 +171,17 @@ class BaseTrainer:
                 f"got {cfg.rollout.engine!r}")
         self.engine.load_weights(params)
         self.metrics_history: list = []
+        # Deferred-stats pipeline (sync train() only): when True,
+        # build_experience/update_epochs leave stats as device scalars;
+        # train() piggybacks their fetch on the NEXT iteration's
+        # generation fetch, so each iteration blocks on exactly ONE
+        # device→host round-trip (the tunnel RTT is ~112 ms; the old
+        # loop paid it 3x per iteration).  The async orchestrator calls
+        # build_experience/update_epochs directly and keeps the eager
+        # (False) behavior.
+        self._defer_stats = False
+        self._pending_fetch = None
+        self._pending_meta = None
         self._rng = jax.random.key(cfg.seed)
         self._np_rng = np.random.RandomState(cfg.seed)
         self._jit_logprobs = jax.jit(
@@ -204,26 +215,56 @@ class BaseTrainer:
             out, inter = self.model.apply(
                 {"params": params}, sequences, positions,
                 mutable=["intermediates"], **apply_kw)
-            leaves = jax.tree.leaves(inter)
-            aux = sum(jnp.mean(x) for x in leaves) / max(len(leaves), 1)
+            # Only the router's 'moe_aux_loss' sows feed the loss — any
+            # other sown diagnostic (activation stats, attention probes)
+            # must NOT silently shift the training objective (ADVICE r2).
+            leaves = [x for path, x in
+                      jax.tree_util.tree_flatten_with_path(inter)[0]
+                      if any(getattr(k, "key", None) == "moe_aux_loss"
+                             for k in path)]
+            if not leaves:
+                raise ValueError(
+                    "num_experts > 0 but no 'moe_aux_loss' intermediates "
+                    "were sown — router aux loss would be silently zero")
+            aux = sum(jnp.mean(x) for x in leaves) / len(leaves)
         else:
             out = self.model.apply({"params": params}, sequences,
                                    positions, **apply_kw)
             aux = jnp.zeros((), jnp.float32)
         return out, aux
 
+    def _windowed_forward(self, params, sequences, prompt_lens,
+                          max_new: int, with_entropy: bool = True,
+                          **apply_kw):
+        """Shared completion-window forward: the vocab projection runs
+        only at the T completion positions (ops.logprobs.completion_
+        window_positions) — the [B, L, V] f32 logits at full length are
+        the biggest tensor in the pipeline and 2/3 of them were thrown
+        away (r3 perf).  Returns (lp [B,T], ent [B,T] | None, extra
+        apply outputs, aux) where ``extra`` carries whatever the module
+        returned beyond logits (e.g. values for ActorCriticModel)."""
+        from orion_tpu.ops.logprobs import (completion_window_positions,
+                                            windowed_completion_logprobs)
+
+        L = sequences.shape[1]
+        positions = jnp.broadcast_to(
+            jnp.arange(L, dtype=jnp.int32), sequences.shape)
+        widx = completion_window_positions(prompt_lens, max_new, L)
+        out, aux = self._policy_apply(
+            params, sequences, positions, logits_positions=widx,
+            **apply_kw)
+        logits_w, extra = out[0], out[1:]
+        lp = windowed_completion_logprobs(logits_w, sequences, prompt_lens,
+                                          max_new)
+        ent = entropy_from_logits(logits_w) if with_entropy else None
+        return lp, ent, extra, aux
+
     def _logprobs_fn(self, params, sequences, prompt_lens, max_new: int):
         """Completion logprobs + entropy (+ MoE aux loss) under the
-        training graph."""
-        positions = jnp.broadcast_to(
-            jnp.arange(sequences.shape[1], dtype=jnp.int32), sequences.shape)
-        (logits, _), aux = self._policy_apply(params, sequences, positions)
-        lp = completion_logprobs(logits, sequences, prompt_lens, max_new)
-        ent = entropy_from_logits(logits)
-        idx = jnp.clip(
-            prompt_lens[:, None] + jnp.arange(max_new)[None, :] - 1,
-            0, logits.shape[1] - 1)
-        return lp, (jnp.take_along_axis(ent, idx, axis=1), aux)
+        training graph, over the completion window."""
+        lp, ent, _, aux = self._windowed_forward(
+            params, sequences, prompt_lens, max_new)
+        return lp, (ent, aux)
 
     def loss_fn(self, params, mb: Dict[str, jnp.ndarray]):
         raise NotImplementedError
@@ -323,10 +364,22 @@ class BaseTrainer:
     def make_experience(self, batch: dict):
         """Synchronous pipeline front half: prompts → generate → score →
         experience (SURVEY.md §3a).  Exactly one device→host fetch of
-        the generation (plus one scalar fetch for model-based rewards)."""
+        the generation (plus one scalar fetch for model-based rewards);
+        any stats tree staged in ``self._pending_fetch`` (the deferred
+        previous-iteration stats) rides the same fetch for free."""
         ids, lens, meta = self.prepare_prompts(batch)
         result = self.generate(ids, lens)
-        host = result.to_host()
+        pend, self._pending_fetch = self._pending_fetch, None
+        fetched = jax.device_get({"r": result._fields(), "p": pend})
+        if self._pending_meta is not None:
+            # Finalize the previous iteration NOW — before this
+            # iteration's build_experience reads kl_ctl.value — so the
+            # KL controller sees iteration i's KL before iteration
+            # i+1's rewards are shaped, exactly like the eager path.
+            meta_p, self._pending_meta = self._pending_meta, None
+            self._finalize_iteration(meta_p, fetched["p"],
+                                     now=meta_p["t_next"])
+        host = GenerationResult(**fetched["r"])
         wants_device = getattr(self.reward_fn, "wants_device_result", False)
         scores = self.score(result if wants_device else host, meta)
         return self.build_experience(result, scores, host=host)
@@ -347,8 +400,12 @@ class BaseTrainer:
         self.state, stats = self._jit_epochs(self.state, experience, idx_mat)
         return stats
 
-    def update_epochs(self, experience: Dict[str, jnp.ndarray]) -> dict:
-        """num_epochs passes of shuffled minibatches (hot loop #2)."""
+    def update_epochs(self, experience: Dict[str, jnp.ndarray],
+                      defer: bool = False) -> dict:
+        """num_epochs passes of shuffled minibatches (hot loop #2).
+        ``defer=True`` (sync train loop) returns the stacked
+        per-minibatch DEVICE stats without fetching — the fetch rides
+        the next iteration's generation round-trip."""
         B = int(experience["prompt_lens"].shape[0])
         mb = self.cfg.minibatch_size
         assert B % mb == 0, f"batch {B} not divisible by minibatch {mb}"
@@ -356,8 +413,16 @@ class BaseTrainer:
                           for _ in range(self.cfg.num_epochs)])
         idx_mat = jnp.asarray(perms.reshape(-1, mb).astype(np.int32))
         stats = self._run_epochs(experience, idx_mat)
+        if defer:
+            return stats
         host = jax.device_get(stats)  # ONE batched transfer
         return {k: float(np.mean(v)) for k, v in host.items()}
+
+    def _on_host_stats(self, stats: dict, n_samples: int) -> None:
+        """Hook: called by the deferred-stats pipeline once an
+        iteration's stats land on host (PPO updates its KL controller
+        here — same position in the update order as the eager path:
+        always before the NEXT iteration's build_experience)."""
 
     def sync_weights(self) -> None:
         """Trainer → rollout weight sync (SURVEY.md §2 #11).  Sync mode:
@@ -435,38 +500,97 @@ class BaseTrainer:
         else:
             n = max(0, self.cfg.total_iterations - self.global_iter)
         prof = _ProfileWindow(self.cfg)
-        for it in range(n):
-            prof.step(it)
-            t0 = time.perf_counter()
-            batch = next(prompt_iter)
-            with jax.named_scope("experience"):
-                experience, exp_stats = self.make_experience(batch)
-            t1 = time.perf_counter()
-            with jax.named_scope("update"):
-                stats = self.update_epochs(experience)
-            self.sync_weights()
-            t2 = time.perf_counter()
-            stats.update(exp_stats)
-            n_samples = int(experience["prompt_lens"].shape[0])
-            stats.update({
-                "iteration": it,
-                "time_rollout_s": t1 - t0,
-                "time_update_s": t2 - t1,
-                "samples_per_sec": n_samples / (t2 - t0),
-            })
-            self.global_iter += 1
-            self.metrics_history.append(stats)
-            if self.writer is not None:
-                self.writer.write(self.global_iter, stats)
-            if self.cfg.log_every and it % self.cfg.log_every == 0:
-                self.log(stats)
-            if self.ckpt is not None and \
-                    self.global_iter % self.cfg.checkpoint_every == 0:
-                self.save_checkpoint(prompt_iter)
+        # Deferred-stats pipeline: iteration i dispatches its update and
+        # immediately starts iteration i+1's generation; i's stats are
+        # fetched as a free rider on i+1's generation fetch.  Each
+        # iteration blocks on exactly one device round-trip, and the
+        # device never idles waiting for a stats fetch.  The KL
+        # controller update keeps its eager-path position (before the
+        # next build_experience).
+        pending = None
+        self._defer_stats = True
+        try:
+            for it in range(n):
+                prof.step(it)
+                t0 = time.perf_counter()
+                batch = next(prompt_iter)
+                if pending is not None:
+                    self._pending_fetch = pending["dev"]
+                    # steady-state wall attribution: iteration i ends
+                    # where iteration i+1 begins.  make_experience
+                    # finalizes the pending iteration right after the
+                    # batched fetch (before build_experience reads the
+                    # KL coefficient).
+                    pending["t_next"] = t0
+                    self._pending_meta = pending
+                    pending = None
+                with jax.named_scope("experience"):
+                    experience, exp_stats = self.make_experience(batch)
+                t1 = time.perf_counter()
+                with jax.named_scope("update"):
+                    upd_dev = self.update_epochs(experience, defer=True)
+                self.sync_weights()
+                t2 = time.perf_counter()
+                self.global_iter += 1
+                pending = {
+                    "dev": {"exp": exp_stats, "upd": upd_dev},
+                    "n": int(experience["prompt_lens"].shape[0]),
+                    "it": it, "giter": self.global_iter,
+                    "t0": t0, "t1": t1, "t2": t2,
+                }
+                if self.ckpt is not None and \
+                        self.global_iter % self.cfg.checkpoint_every == 0:
+                    # Materialize this iteration's stats first so the
+                    # checkpointed KL coefficient includes this
+                    # iteration's measured KL (identical to the eager
+                    # path); costs one extra fetch on checkpoint
+                    # iterations only.
+                    fetched = jax.device_get(pending["dev"])
+                    self._finalize_iteration(pending, fetched,
+                                             now=time.perf_counter())
+                    pending = None
+                    self.save_checkpoint(prompt_iter)
+            if pending is not None:  # flush the last iteration's stats
+                fetched = jax.device_get(pending["dev"])
+                self._finalize_iteration(pending, fetched,
+                                         now=time.perf_counter())
+        finally:
+            self._defer_stats = False
+            self._pending_fetch = None
+            self._pending_meta = None
         prof.stop()
         if self.ckpt is not None:
             self.ckpt.wait()
         return self.metrics_history
+
+    def _finalize_iteration(self, pending: dict, fetched: dict,
+                            now: float) -> None:
+        """Materialize a deferred iteration's stats (host side): merge
+        experience + update stats, run the KL-controller hook, log.
+        ``samples_per_sec`` uses wall-clock up to *now* — in steady
+        state that is the next iteration's fetch completion, i.e. the
+        honest end-to-end rate including the deferred update's device
+        execution."""
+        def scal(v):
+            return float(np.mean(v)) if hasattr(v, "ndim") else v
+
+        stats = {k: scal(v) for k, v in fetched["upd"].items()}
+        stats.update({k: scal(v) for k, v in fetched["exp"].items()})
+        self._on_host_stats(stats, pending["n"])
+        stats.update({
+            "iteration": pending["it"],
+            "time_rollout_s": pending["t1"] - pending["t0"],
+            "time_update_s": pending["t2"] - pending["t1"],
+            "samples_per_sec": pending["n"] / max(now - pending["t0"], 1e-9),
+        })
+        self.metrics_history.append(stats)
+        if self.writer is not None:
+            # giter: the global counter at dispatch time — monotone
+            # across resumed runs (a loop-local index would rewrite
+            # steps 1..n of the metrics log after every resume).
+            self.writer.write(pending["giter"], stats)
+        if self.cfg.log_every and pending["it"] % self.cfg.log_every == 0:
+            self.log(stats)
 
     def log(self, stats: dict) -> None:
         keys = ("iteration", "reward_mean", "loss", "kl", "samples_per_sec")

@@ -99,23 +99,14 @@ class PPOTrainer(BaseTrainer):
                        max_new: int, with_entropy: bool = True):
         """Shared-trunk forward: completion logprobs (+ entropy when the
         caller needs it — a full-vocab softmax reduce it should not pay
-        for on the experience pass) AND values from one backbone pass."""
-        positions = jnp.broadcast_to(
-            jnp.arange(sequences.shape[1], dtype=jnp.int32),
-            sequences.shape)
-        (logits, values, _), aux = self._policy_apply(
-            params, sequences, positions, with_values=True)
-        from orion_tpu.ops.logprobs import (completion_logprobs,
-                                            entropy_from_logits)
-
-        lp = completion_logprobs(logits, sequences, prompt_lens, max_new)
-        ent = None
-        if with_entropy:
-            ent = entropy_from_logits(logits)
-            idx = jnp.clip(
-                prompt_lens[:, None] + jnp.arange(max_new)[None, :] - 1,
-                0, logits.shape[1] - 1)
-            ent = jnp.take_along_axis(ent, idx, axis=1)
+        for on the experience pass) AND values from one backbone pass.
+        The vocab projection runs only over the completion window via
+        BaseTrainer._windowed_forward (values still read the full
+        hidden states)."""
+        lp, ent, extra, aux = self._windowed_forward(
+            params, sequences, prompt_lens, max_new,
+            with_entropy=with_entropy, with_values=True)
+        values = extra[0]
         return (lp, ent,
                 self._gather_completion(values, prompt_lens, mask), aux)
 
@@ -145,14 +136,22 @@ class PPOTrainer(BaseTrainer):
         if self.cfg.whiten_advantages:
             advantages = masked_whiten(advantages, mask)
 
-        # One batched fetch for every device scalar this step needs.
-        dev = jax.device_get({
+        dev = {
             "kl": masked_mean(kl, mask),
             "value_mean": masked_mean(values, mask),
             "return_mean": masked_mean(returns, mask),
-        })
-        mean_kl = float(dev["kl"])
-        self.kl_ctl.update(mean_kl, int(mask.shape[0]))
+        }
+        if self._defer_stats:
+            # Sync pipelined loop: leave the scalars on device; the
+            # train loop fetches them with the NEXT iteration's
+            # generation fetch and runs _on_host_stats (the KL
+            # controller update) at the same point in the update order
+            # as the eager path below.
+            pass
+        else:
+            dev = {k: float(v) for k, v in
+                   jax.device_get(dev).items()}  # one batched fetch
+            self.kl_ctl.update(dev["kl"], int(mask.shape[0]))
 
         experience = {
             "sequences": result.sequences,
@@ -167,13 +166,16 @@ class PPOTrainer(BaseTrainer):
         stats = {
             "reward_mean": float(np.mean(scores)),
             "reward_std": float(np.std(scores)),
-            "kl": mean_kl,
             "kl_coef": self.kl_ctl.value,
-            "value_mean": float(dev["value_mean"]),
-            "return_mean": float(dev["return_mean"]),
             "completion_len_mean": float(np.mean(np.asarray(lens))),
+            **dev,
         }
         return experience, stats
+
+    def _on_host_stats(self, stats: dict, n_samples: int) -> None:
+        """Deferred-pipeline KL-controller update (see BaseTrainer)."""
+        if "kl" in stats:
+            self.kl_ctl.update(float(stats["kl"]), n_samples)
 
     # ------------------------------------------------------------------
     def loss_fn(self, params, mb):

@@ -42,10 +42,14 @@ class RLOOTrainer(BaseTrainer):
             "advantages": adv,  # [B] sequence-level
         }
         lens = (host or result).completion_lens
+        kl_mean = jnp.mean(kl_seq)
         stats = {
             "reward_mean": float(np.mean(scores)),
-            # one batched scalar fetch (kl lives on device)
-            "kl": float(jax.device_get(jnp.mean(kl_seq))),
+            # device scalar under the deferred pipeline (the sync train
+            # loop fetches it with the next generation); one scalar
+            # fetch otherwise (async path).
+            "kl": kl_mean if self._defer_stats
+            else float(jax.device_get(kl_mean)),
             "completion_len_mean": float(np.mean(np.asarray(lens))),
         }
         return experience, stats

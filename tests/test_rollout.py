@@ -123,3 +123,28 @@ def test_ragged_prompts_match_unpadded(tiny_setup):
                                   np.asarray(res_a.completions[0]))
     np.testing.assert_array_equal(np.asarray(res.completions[1]),
                                   np.asarray(res_b.completions[0]))
+
+
+def test_windowed_logprobs_match_full(tiny_setup):
+    """completion-window logits (r3 perf path) are numerically identical
+    to the full-logits oracle, ragged prompt lengths included."""
+    from orion_tpu.ops.logprobs import (completion_logprobs,
+                                        completion_window_positions,
+                                        windowed_completion_logprobs)
+
+    cfg, model, params = tiny_setup
+    rng = np.random.RandomState(3)
+    B, L, T = 3, 12, 5
+    seqs = jnp.asarray(rng.randint(1, cfg.vocab_size, (B, L)), jnp.int32)
+    lens = jnp.asarray([3, 7, 5], jnp.int32)
+    positions = jnp.broadcast_to(jnp.arange(L, dtype=jnp.int32), (B, L))
+
+    logits, _ = model.apply({"params": params}, seqs, positions)
+    full = completion_logprobs(logits, seqs, lens, T)
+
+    widx = completion_window_positions(lens, T, L)
+    logits_w, _ = model.apply({"params": params}, seqs, positions,
+                              logits_positions=widx)
+    win = windowed_completion_logprobs(logits_w, seqs, lens, T)
+    np.testing.assert_allclose(np.asarray(win), np.asarray(full),
+                               rtol=1e-6, atol=1e-6)
