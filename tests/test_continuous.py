@@ -99,3 +99,24 @@ def test_continuous_rejects_oversized_prompt():
 
     with pytest.raises(ValueError, match="longer than"):
         eng.generate([(0, np.ones(13, np.int32))], jax.random.key(0), params)
+
+
+def test_per_request_budgets_ragged():
+    """Per-request max_new budgets (the ragged-workload case): each
+    request stops at its own budget and frees its slot for waiting
+    work; reservations shrink with the budget."""
+    cfg, model, params, eng, solo = _setup(max_new=10, slots=2)
+    rng = np.random.RandomState(5)
+    reqs = [(i, rng.randint(1, cfg.vocab_size, 4 + i % 3).astype(np.int32),
+             2 + 2 * i)  # budgets 2, 4, 6, 8, 10
+            for i in range(5)]
+    out = eng.generate(reqs, jax.random.key(9), params=params)
+    assert sorted(r.req_id for r in out) == list(range(5))
+    for r in out:
+        budget = 2 + 2 * r.req_id
+        # no EOS configured -> exactly budget tokens, matching the
+        # solo engine's first `budget` greedy tokens
+        assert len(r.tokens) == budget
+        ids = np.asarray([q[1] for q in reqs if q[0] == r.req_id][0])
+        expect = _solo_completion(solo, ids, 10)[:budget]
+        np.testing.assert_array_equal(r.tokens, expect)

@@ -174,3 +174,25 @@ def test_moe_aux_survives_scan_layers():
     # same params (stacked vs unrolled trees differ, but both inits use
     # the same structure family) -> aux magnitudes in the same regime
     assert abs(aux[True] - aux[False]) < 0.5, aux
+
+
+def test_moe_quantized_decode_generates():
+    """quantize_weights must not desync the MoE param tree: the router
+    stays a plain Dense (skipped by quantize_params_int8) while the
+    block Denses go int8 (r3 review finding)."""
+    from orion_tpu.config import ModelConfig, RolloutConfig
+    from orion_tpu.models import Transformer, init_params
+    from orion_tpu.rollout.engine import RolloutEngine
+
+    cfg = ModelConfig.tiny(dtype="float32", param_dtype="float32",
+                           num_experts=2)
+    model = Transformer(cfg)
+    params = init_params(model, jax.random.key(0), cfg)
+    rc = RolloutConfig(max_prompt_len=8, max_new_tokens=4,
+                       temperature=0.0, quantize_weights=True)
+    eng = RolloutEngine(model, cfg, rc, eos_token_id=None)
+    eng.load_weights(params)
+    ids = jnp.asarray(np.random.RandomState(0).randint(
+        2, cfg.vocab_size, (2, 8)), jnp.int32)
+    r = eng.generate(ids, jnp.full((2,), 8, jnp.int32), jax.random.key(1))
+    assert np.isfinite(np.asarray(r.logprobs)).all()

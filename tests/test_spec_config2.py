@@ -1,0 +1,119 @@
+"""SPEC config 2 end to end (BASELINE.json.configs[1], VERDICT r2
+missing #5): PPO with a SEPARATE reward model scoring on-device in the
+loop — policy + RM + critic composed exactly as launch.build_reward /
+build_trainer would, on the 8-fake-CPU-device mesh.
+
+The RM is a ScalarHeadModel whose head is rigged (trained on nothing —
+its random head happens to induce SOME preference ordering; instead we
+plant a head that rewards emitting the lucky token) so "reward rises"
+is a real end-to-end signal through the on-device scoring path."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from orion_tpu.config import MeshConfig, PPOConfig, OptimizerConfig
+from orion_tpu.models import Transformer, init_params
+from orion_tpu.models.heads import (ActorCriticModel, ScalarHeadModel,
+                                    init_scalar_params,
+                                    wrap_actor_critic_params)
+from orion_tpu.models.sharded import make_sharded_model
+from orion_tpu.parallel.mesh import make_mesh
+from orion_tpu.rewards import ModelReward
+from orion_tpu.trainers import PPOTrainer
+
+from test_trainers import LUCKY, prompt_stream, tiny_model_cfg
+
+
+def _rigged_rm(mesh):
+    """A reward model whose score is ~(count of LUCKY embeddings in the
+    sequence): embedding row LUCKY is planted along the head direction,
+    so the RM genuinely computes its score from the token content via
+    the full backbone+head forward (not a host-side shortcut)."""
+    cfg = tiny_model_cfg()
+    rm = ScalarHeadModel(cfg)
+    init_args = (jnp.zeros((1, 2), jnp.int32), jnp.zeros((1, 2), jnp.int32))
+    params, _ = make_sharded_model(rm, mesh, jax.random.key(7), init_args)
+
+    # plant: make the LUCKY token's embedding large along one axis and
+    # the head read that axis — last-token value correlates with how
+    # recently/strongly LUCKY content flowed through the residual.
+    emb = np.array(params["backbone"]["embed"]["embedding"],
+                   np.float32)
+    emb[LUCKY] = 0.0
+    emb[LUCKY, 0] = 4.0
+    head = np.zeros(
+        np.asarray(params["score_head"]["kernel"]).shape, np.float32)
+    head[0, 0] = 1.0
+    params = dict(params)
+    params["backbone"] = dict(params["backbone"])
+    params["backbone"]["embed"] = {"embedding": jnp.asarray(emb)}
+    params["score_head"] = {"kernel": jnp.asarray(head)}
+    return ModelReward(rm, params)
+
+
+def test_ppo_with_separate_reward_model_end_to_end():
+    mesh = make_mesh(MeshConfig(data=1, fsdp=-1, seq=1, tensor=1))
+    cfg = PPOConfig()
+    cfg.model = tiny_model_cfg()
+    cfg.share_backbone = True
+    cfg.kl_coef = 0.0
+    cfg.num_epochs = 2
+    cfg.vf_coef = 0.05
+    cfg.rollout.max_prompt_len = 8
+    cfg.rollout.max_new_tokens = 8
+    cfg.rollout.temperature = 1.0
+    cfg.rollout_batch_size = 16
+    cfg.minibatch_size = 8
+    cfg.log_every = 0
+    cfg.optimizer = OptimizerConfig(learning_rate=1e-2, grad_clip=1.0)
+
+    with mesh:
+        reward = _rigged_rm(mesh)
+        assert getattr(reward, "wants_device_result", False)
+
+        model = ActorCriticModel(cfg.model)
+        base = Transformer(cfg.model)
+        host = init_params(base, jax.random.key(0), cfg.model)
+        wrapped = wrap_actor_critic_params(host, cfg.model)
+        trainer = PPOTrainer(cfg, model, wrapped, reward_fn=reward,
+                             eos_token_id=None, pad_token_id=0)
+        hist = trainer.train(prompt_stream(16, 5), num_iterations=12)
+
+    first = np.mean([h["reward_mean"] for h in hist[:3]])
+    last = np.mean([h["reward_mean"] for h in hist[-3:]])
+    # the RM pays for LUCKY-token content; PPO should find it
+    assert last > first + 0.05, (first, last)
+    for h in hist:
+        assert np.isfinite(h["loss"]) and np.isfinite(h["kl"])
+
+
+def test_model_reward_scores_on_device_one_fetch():
+    """The RM scores the DEVICE result (wants_device_result): sequences
+    are not re-uploaded and only [B] scalars cross to host."""
+    mesh = make_mesh(MeshConfig(data=1, fsdp=-1, seq=1, tensor=1))
+    with mesh:
+        reward = _rigged_rm(mesh)
+        B, L = 4, 12
+        vocab = tiny_model_cfg().vocab_size
+        seqs = jnp.asarray(
+            np.random.RandomState(0).randint(2, vocab, (B, L)), jnp.int32)
+        lens = jnp.full((B,), L, jnp.int32)
+
+        class R:  # minimal GenerationResult stand-in
+            sequences = seqs
+            total_lens = lens
+
+        scores = reward(R(), {})
+    assert scores.shape == (B,)
+    # planting LUCKY at the end must raise the score
+    seq2 = np.asarray(seqs).copy()
+    seq2[:, -1] = LUCKY
+
+    class R2:
+        sequences = jnp.asarray(seq2)
+        total_lens = lens
+
+    with mesh:
+        s2 = reward(R2(), {})
+    assert float(np.mean(np.asarray(s2))) > float(np.mean(np.asarray(scores)))
