@@ -1,0 +1,153 @@
+"""On-chip long-context kernel timings (VERDICT r3 task #5, second
+half): flash fwd AND bwd at 16k/32k, plus the per-device compute of
+the two sequence-parallel schemes at s=2 — Ulysses (full sequence,
+H/s heads, exact flash) vs ring (L/s queries × full rotation of L/s-
+key chunks).  One chip cannot measure the collectives (all_to_all vs
+ppermute ride ICI on a real slice); what it CAN measure is each
+scheme's local kernel time, which is the dominant term at these
+lengths.  Timing uses the fetch+rep-differencing recipe (RTT cancels;
+see PERF.md r3 methodology note).
+
+Run: python scripts/bench_longctx.py   (~10 min incl. compiles)
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+from functools import partial
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+jax.config.update("jax_compilation_cache_dir", "/tmp/jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 5.0)
+
+B, H, HKV, D = 1, 16, 8, 128
+LO, HI = 2, 8
+
+
+def timed_fetch(fn, *args, n=4):
+    np.asarray(fn(*args))
+    ts = []
+    for _ in range(n):
+        t0 = time.perf_counter()
+        np.asarray(fn(*args))
+        ts.append(time.perf_counter() - t0)
+    return float(np.median(ts))
+
+
+def per_rep(make_fn, *args, label=""):
+    t_lo = timed_fetch(make_fn(LO), *args)
+    t_hi = timed_fetch(make_fn(HI), *args)
+    s = (t_hi - t_lo) / (HI - LO)
+    print(f"{label}: {s*1e3:9.1f} ms", flush=True)
+    return s
+
+
+def qkv(L, Hq=H, Hkv=HKV, seed=0):
+    ks = jax.random.split(jax.random.key(seed), 3)
+    q = jax.random.normal(ks[0], (B, L, Hq, D), jnp.bfloat16)
+    k = jax.random.normal(ks[1], (B, L, Hkv, D), jnp.bfloat16)
+    v = jax.random.normal(ks[2], (B, L, Hkv, D), jnp.bfloat16)
+    pos = jnp.broadcast_to(jnp.arange(L, dtype=jnp.int32), (B, L))
+    return q, k, v, pos
+
+
+def main():
+    from orion_tpu.ops.pallas.flash_attention import (flash_attention_gqa,
+                                                      flash_chunk_fwd,
+                                                      flash_chunk_grads)
+
+    scale = 1.0 / D ** 0.5
+    for L in (16384, 32768):
+        q, k, v, pos = qkv(L)
+
+        def mk_fwd(n):
+            @jax.jit
+            def f(q, k, v):
+                def body(i, acc):
+                    o = flash_attention_gqa(q + 0.001 * i, k, v, pos,
+                                            scale)
+                    return acc + o[:, 0, 0, 0].astype(jnp.float32)
+                return jax.lax.fori_loop(0, n, body,
+                                         jnp.zeros((B,), jnp.float32))
+            return f
+
+        t_f = per_rep(mk_fwd, q, k, v, label=f"flash fwd   L={L:6d}")
+        flops = 4.0 * B * H * D * L * L / 2
+        print(f"    -> {flops/t_f/1e12:6.1f} TFLOP/s causal", flush=True)
+
+        def mk_bwd(n):
+            def loss(q, k, v, i):
+                o = flash_attention_gqa(q + 0.001 * i, k, v, pos, scale)
+                return jnp.sum(o.astype(jnp.float32) ** 2)
+
+            g = jax.grad(loss, argnums=(0, 1, 2))
+
+            @jax.jit
+            def f(q, k, v):
+                def body(i, acc):
+                    dq, dk, dv = g(q, k, v, i)
+                    return acc + dq[:, 0, 0, 0].astype(jnp.float32)
+                return jax.lax.fori_loop(0, n, body,
+                                         jnp.zeros((B,), jnp.float32))
+            return f
+
+        t_b = per_rep(mk_bwd, q, k, v, label=f"flash fwd+bwd L={L:6d}")
+        print(f"    -> {3.5*flops/t_b/1e12:6.1f} TFLOP/s eff", flush=True)
+
+    # s=2 per-device workloads at global L=32k:
+    #   Ulysses: full 32k sequence, H/2 query heads, ONE exact flash.
+    #   Ring:    16k queries, two 16k-key chunk passes (flash_chunk).
+    Lg = 32768
+    print(f"\nper-device compute at s=2, global L={Lg}:")
+    qU, kU, vU, posU = qkv(Lg, Hq=H // 2, Hkv=HKV // 2, seed=1)
+
+    def mk_uly(n):
+        @jax.jit
+        def f(q, k, v):
+            def body(i, acc):
+                o = flash_attention_gqa(q + 0.001 * i, k, v, posU,
+                                        scale)
+                return acc + o[:, 0, 0, 0].astype(jnp.float32)
+            return jax.lax.fori_loop(0, n, body,
+                                     jnp.zeros((B,), jnp.float32))
+        return f
+
+    t_u = per_rep(mk_uly, qU, kU, vU,
+                  label=f"ulysses local (L={Lg}, H={H//2})")
+
+    Lh = Lg // 2
+    qR, kR, vR, posR = qkv(Lh, seed=2)
+    pos_hi = posR + Lh  # the local queries sit in the SECOND half
+
+    def mk_ring(n):
+        @jax.jit
+        def f(q, k, v):
+            def body(i, acc):
+                # rotation 1: own chunk (causal within)
+                o1, _ = flash_chunk_fwd(q + 0.001 * i, k, v, pos_hi,
+                                        pos_hi, scale)
+                # rotation 2: the other chunk (fully visible)
+                o2, _ = flash_chunk_fwd(q + 0.001 * i, k, v, pos_hi,
+                                        posR, scale)
+                return acc + (o1 + o2)[:, 0, 0, 0].astype(jnp.float32)
+            return jax.lax.fori_loop(0, n, body,
+                                     jnp.zeros((B,), jnp.float32))
+        return f
+
+    t_r = per_rep(mk_ring, qR, kR, vR,
+                  label=f"ring 2 rotations (Lq={Lh}, H={H})")
+    print(f"\nulysses/ring local-compute ratio: {t_u/t_r:.2f} "
+          "(collectives not measurable on one chip: ulysses pays 2 "
+          "all_to_alls of the activations, ring pays s-1 KV ppermutes)")
+
+
+if __name__ == "__main__":
+    main()

@@ -153,3 +153,31 @@ def test_shared_ppo_async_mode():
     for h in history:
         assert np.isfinite(h["loss"])
         assert 0 <= h["staleness"] <= 1
+
+
+def test_deferred_pipeline_kl_controller_order():
+    """The deferred-stats pipeline must feed the adaptive KL controller
+    exactly once per iteration, BEFORE the next iteration's rewards are
+    shaped (same order as the eager path), and metrics_history must
+    contain every iteration after train() returns."""
+    cfg = _mk(PPOConfig, share_backbone=True, adaptive_kl=True,
+              kl_coef=0.1, kl_target=0.01, kl_horizon=100, num_epochs=1)
+    model = ActorCriticModel(cfg.model)
+    params = init_params(model, jax.random.key(0), cfg.model)
+    tr = PPOTrainer(cfg, model, params, reward_fn=lucky_token_reward,
+                    eos_token_id=None)
+
+    calls = []
+    orig = tr.kl_ctl.update
+
+    def spy(kl, n):
+        calls.append(float(kl))
+        return orig(kl, n)
+
+    tr.kl_ctl.update = spy
+    n = 4
+    hist = tr.train(prompt_stream(8, 5), num_iterations=n)
+    assert len(hist) == n
+    assert len(calls) == n, f"kl_ctl.update called {len(calls)} times"
+    # history stats carry the same kl values the controller saw, in order
+    np.testing.assert_allclose([h["kl"] for h in hist], calls, rtol=1e-6)
