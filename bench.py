@@ -28,11 +28,45 @@ from __future__ import annotations
 
 import json
 import os
+import subprocess
+import sys
 import time
 
 import numpy as np
 
 V5E_PEAK_FLOPS = 197e12  # bf16 dense, one v5e chip
+
+
+def _probe_backend(timeout: float = 90, attempts: int = 2):
+    """(backend, error): initialize jax's default backend in a
+    SUBPROCESS with a hard timeout.  A sick axon tunnel hangs forever
+    inside ``make_c_api_client`` (r3: the judge blocked 240s; the
+    driver's bench artifact was rc=1 with a raw traceback) — in-process
+    try/except catches errors, not hangs, so the probe must be a child
+    process we can kill.  Bounded retry, then CPU fallback with the
+    reason recorded for the bench JSON."""
+    reason = ""
+    for i in range(attempts):
+        try:
+            r = subprocess.run(
+                [sys.executable, "-c",
+                 "import jax; print(jax.default_backend())"],
+                capture_output=True, text=True, timeout=timeout)
+            if r.returncode == 0 and r.stdout.strip():
+                return r.stdout.strip().splitlines()[-1], ""
+            reason = (f"backend init rc={r.returncode}: "
+                      f"{r.stderr.strip()[-200:]}")
+        except subprocess.TimeoutExpired:
+            reason = (f"backend init hang >{timeout:.0f}s "
+                      f"(attempt {i + 1}/{attempts})")
+    return "cpu", reason
+
+
+def _pin_cpu() -> None:
+    """Never touch the (possibly hung) TPU plugin in this process."""
+    from orion_tpu.utils.platform import force_cpu_platform
+
+    force_cpu_platform()
 
 
 def param_count(tree) -> int:
@@ -48,12 +82,10 @@ def _length_reward(result, batch):
         [len(np.unique(t)) for t in toks], np.float32) / toks.shape[1]
 
 
-def _preset():
-    import jax
-
+def _preset(backend: str):
     name = os.environ.get("ORION_BENCH_PRESET")
     if name is None:
-        name = "ppo1b" if jax.default_backend() == "tpu" else "tiny"
+        name = "ppo1b" if backend == "tpu" else "tiny"
     from orion_tpu.config import (GRPOConfig, ModelConfig, OptimizerConfig,
                                   PPOConfig)
 
@@ -159,9 +191,12 @@ def lower_8b_check() -> str:
 
 
 def main() -> None:
+    backend, backend_err = _probe_backend()
+    if backend != "tpu":
+        _pin_cpu()
     import jax
 
-    name, cfg = _preset()
+    name, cfg = _preset(backend)
     trainer = build_trainer(name, cfg)
     n_params = param_count(trainer.state.params)
 
@@ -204,8 +239,7 @@ def main() -> None:
     toks_per_sec = value * mean_new
     algo = "ppo" if name == "ppo1b" else "grpo"
     fps = flops_per_sample(n_params, cfg, mean_new)
-    mfu = value * fps / V5E_PEAK_FLOPS if \
-        jax.default_backend() == "tpu" else 0.0
+    mfu = value * fps / V5E_PEAK_FLOPS if backend == "tpu" else 0.0
 
     compile_8b = ""
     if name == "ppo1b" and os.environ.get("ORION_BENCH_8B", "1") != "0":
@@ -236,10 +270,23 @@ def main() -> None:
         "tokens_per_sec": round(toks_per_sec, 1),
         "mfu": round(mfu, 4),
     }
+    if backend_err:
+        # CPU-fallback run on a sick chip: the number is real but NOT
+        # the TPU headline — mark it so the artifact can't be misread.
+        out["error"] = f"tpu_unavailable: {backend_err}"
     if compile_8b:
         out["compile_8b"] = compile_8b
     print(json.dumps(out))
 
 
 if __name__ == "__main__":
-    main()
+    try:
+        main()
+    except Exception as e:  # the artifact must stay parseable (r3: rc=1
+        import traceback    # with a raw traceback -> parsed: null)
+        traceback.print_exc(file=sys.stderr)
+        print(json.dumps({
+            "metric": "PPO samples/sec (rollout+update) — bench failed",
+            "value": 0.0, "unit": "samples/sec", "vs_baseline": 0.0,
+            "error": f"{type(e).__name__}: {str(e)[:300]}"}))
+        sys.exit(0)

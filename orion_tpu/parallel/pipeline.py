@@ -120,10 +120,17 @@ def pipeline_blocks(cfg: ModelConfig, stage_params, x, positions,
     recv0 = jnp.zeros_like(mbs[0])
     (_, outputs), _ = jax.lax.scan(
         step, (recv0, outputs0), jnp.arange(M + S - 1))
-    # outputs valid only on the last stage -> replicate.
+    # outputs valid only on the last stage -> replicate.  The psum (and
+    # therefore its AD-transposed twin in the backward pipeline) runs in
+    # f32: a bf16 all-reduce dies in XLA:CPU's AllReducePromotion pass,
+    # whose rewrite CHECK-fails on the Sharding custom-call that shardy
+    # leaves as the reduction-region root ("Invalid binary instruction
+    # opcode copy" — the r3 dryrun killer), and f32 is numerically
+    # safer for the final activation collect anyway.
     outputs = jax.lax.psum(
-        jnp.where(s == S - 1, outputs, jnp.zeros_like(outputs)), axis)
-    return outputs.reshape((B,) + x.shape[1:])
+        jnp.where(s == S - 1, outputs,
+                  jnp.zeros_like(outputs)).astype(jnp.float32), axis)
+    return outputs.astype(x.dtype).reshape((B,) + x.shape[1:])
 
 
 class PipelinedTransformer:

@@ -72,24 +72,30 @@ def shard_params(params: Any, logical_axes: Any, mesh: Mesh,
     return jax.device_put(params, shardings)
 
 
-# Resolve the ambient-mesh accessor ONCE at import: thread_resources is
-# a private jax API, and a jax upgrade that moves it must fail loudly at
-# import of this module — not silently disable Megatron-SP in a deployed
-# run, losing its memory/comm savings with no signal (ADVICE r2).
-try:
-    from jax._src import mesh as _mesh_lib
-
-    _mesh_lib.thread_resources.env.physical_mesh  # probe the attribute path
-except (ImportError, AttributeError) as _e:  # pragma: no cover - jax upgrade
-    raise ImportError(
-        "orion_tpu.parallel.sharding: jax moved the private "
-        "thread_resources API this module uses to resolve the ambient "
-        "mesh for Megatron-SP activation sharding; update "
-        "constrain_seq_activation for this jax version") from _e
+# thread_resources is a private jax API.  The probe is LAZY — resolved
+# on the first constrain_seq_activation call — so a jax upgrade that
+# moves it breaks only runs that actually enable Megatron-SP, not every
+# import of this (near-universal) module (ADVICE r3).  It still fails
+# LOUDLY for the feature that needs it: a deployed SP run must not
+# silently lose its memory/comm savings with no signal (ADVICE r2).
+_MESH_LIB = None
 
 
 def _ambient_mesh():
-    return _mesh_lib.thread_resources.env.physical_mesh
+    global _MESH_LIB
+    if _MESH_LIB is None:
+        try:
+            from jax._src import mesh as mesh_lib
+
+            mesh_lib.thread_resources.env.physical_mesh  # probe
+        except (ImportError, AttributeError) as e:  # pragma: no cover
+            raise ImportError(
+                "orion_tpu.parallel.sharding: jax moved the private "
+                "thread_resources API used to resolve the ambient mesh "
+                "for Megatron-SP activation sharding; update "
+                "constrain_seq_activation for this jax version") from e
+        _MESH_LIB = mesh_lib
+    return _MESH_LIB.thread_resources.env.physical_mesh
 
 
 def constrain_seq_activation(x):

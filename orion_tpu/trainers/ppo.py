@@ -129,8 +129,13 @@ class PPOTrainer(BaseTrainer):
             self.ref_params, result.sequences, result.prompt_lens, max_new=T)
 
         kl = kl_penalty(old_lp, ref_lp, "k1") * mask
+        # Logged below as `kl_coef`: the PRE-update coefficient — the one
+        # that actually shaped this batch's rewards.  The eager path used
+        # to log the post-update value while the deferred path logged
+        # pre-update (ADVICE r3): one convention now, both branches.
+        kl_coef_used = self.kl_ctl.value
         rewards = per_token_rewards(jnp.asarray(scores), kl, mask,
-                                    self.kl_ctl.value, self.cfg.reward_clip)
+                                    kl_coef_used, self.cfg.reward_clip)
         advantages, returns = gae(rewards, values, mask,
                                   self.cfg.gamma, self.cfg.gae_lambda)
         if self.cfg.whiten_advantages:
@@ -166,7 +171,7 @@ class PPOTrainer(BaseTrainer):
         stats = {
             "reward_mean": float(np.mean(scores)),
             "reward_std": float(np.std(scores)),
-            "kl_coef": self.kl_ctl.value,
+            "kl_coef": kl_coef_used,
             "completion_len_mean": float(np.mean(np.asarray(lens))),
             **dev,
         }

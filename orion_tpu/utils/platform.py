@@ -1,0 +1,48 @@
+"""Process-level platform pinning.
+
+One place for the pin-CPU-before-any-backend-init dance that the test
+harness, the driver hooks, and the bench all need: this box's
+sitecustomize registers the experimental axon TPU plugin at interpreter
+start, and a sick tunnel HANGS (not errors) the first touch of that
+backend inside ``make_c_api_client`` — so every CPU-only entrypoint
+must pin the platform *and* drop any backend jax already built, before
+its first ``jax.devices()``/jit dispatch.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+
+def force_cpu_platform(n_devices: Optional[int] = None) -> None:
+    """Pin this process to the CPU platform (never initializing the TPU
+    plugin), optionally forcing ``n_devices`` virtual host devices.
+
+    Must run before the first backend initialization; safe to call
+    multiple times.  Backends jax may have cached are dropped so the
+    platform pin and the device-count flag take effect — and that uses
+    a private jax API, so a jax upgrade that moves it fails LOUDLY here
+    rather than leaving the process one lazy init away from touching a
+    hung TPU backend.
+    """
+    if n_devices is not None:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags +
+                f" --xla_force_host_platform_device_count={n_devices}"
+            ).strip()
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    try:
+        from jax._src import xla_bridge
+
+        xla_bridge._clear_backends()
+    except (ImportError, AttributeError) as e:  # pragma: no cover
+        raise ImportError(
+            "orion_tpu.utils.platform: jax moved the private "
+            "xla_bridge._clear_backends API this helper relies on; "
+            "update force_cpu_platform for this jax version") from e

@@ -36,25 +36,13 @@ def _tpu_run_requested() -> bool:
 
 TPU_RUN = _tpu_run_requested()
 
-if not TPU_RUN:
-    flags = os.environ.get("XLA_FLAGS", "")
-    if "xla_force_host_platform_device_count" not in flags:
-        os.environ["XLA_FLAGS"] = (
-            flags + " --xla_force_host_platform_device_count=8").strip()
-    os.environ["JAX_PLATFORMS"] = "cpu"
-
 import jax  # noqa: E402
 
 if not TPU_RUN:
-    jax.config.update("jax_platforms", "cpu")
+    from orion_tpu.utils.platform import force_cpu_platform
+
+    force_cpu_platform(8)
     jax.config.update("jax_default_matmul_precision", "highest")
-    if getattr(jax, "_src", None) is not None:
-        # If sitecustomize already touched a backend, drop it so the CPU
-        # platform + forced device count take effect.
-        try:
-            jax._src.xla_bridge._clear_backends()
-        except Exception:
-            pass
 
 import pytest  # noqa: E402
 
