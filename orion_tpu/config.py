@@ -255,6 +255,9 @@ class DataConfig:
     use_chat_template: bool = False
     system_prompt: Optional[str] = None
     synthetic_size: int = 512
+    # Directory of <dataset>.jsonl files in the upstream HF schema —
+    # the offline path for real datasets on a zero-egress box.
+    data_dir: Optional[str] = None
 
 
 @dataclass

@@ -3,4 +3,6 @@ from orion_tpu.data.prompts import (  # noqa: F401
     PromptIterator,
     build_prompt_iterator,
     load_prompt_records,
+    load_tokenizer,
+    render_chat,
 )

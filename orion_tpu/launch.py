@@ -153,7 +153,8 @@ def main(argv: Optional[list] = None) -> Any:
         cfg.rollout.max_prompt_len, split=cfg.data.split, seed=cfg.seed,
         use_chat_template=cfg.data.use_chat_template,
         system_prompt=cfg.data.system_prompt,
-        synthetic_size=cfg.data.synthetic_size)
+        synthetic_size=cfg.data.synthetic_size,
+        data_dir=cfg.data.data_dir)
 
     if cfg.async_mode:
         from orion_tpu.orchestration import AsyncOrchestrator, split_devices

@@ -152,10 +152,13 @@ class Attention(nn.Module):
             # Paged-KV path (rollout engine with RolloutConfig.paged).
             new_cache = write_paged_tokens(layer_cache, k, v, positions)
             if L == 1:
-                # Decode step: Pallas paged attention over the pool.
+                # Decode step: Pallas paged attention over the pool
+                # (tensor-sharded over kv-heads under an ambient mesh —
+                # the _sharded dispatch keeps GSPMD from all-gathering
+                # the pool around the opaque pallas_call).
                 from orion_tpu.ops.pallas.paged_attention import (
-                    paged_decode_attention)
-                paged_decode_out = paged_decode_attention(
+                    paged_decode_attention_sharded)
+                paged_decode_out = paged_decode_attention_sharded(
                     q[:, 0], new_cache["k_pages"], new_cache["v_pages"],
                     new_cache["block_tables"], positions[:, 0] + 1, scale)
                 keys = values = None

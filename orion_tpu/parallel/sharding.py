@@ -81,6 +81,11 @@ def shard_params(params: Any, logical_axes: Any, mesh: Mesh,
 _MESH_LIB = None
 
 
+def ambient_mesh():
+    """The `with mesh:` context's physical mesh (None/empty outside)."""
+    return _ambient_mesh()
+
+
 def _ambient_mesh():
     global _MESH_LIB
     if _MESH_LIB is None:

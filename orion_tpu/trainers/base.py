@@ -155,12 +155,18 @@ class BaseTrainer:
         else:
             self.ref_params = None
         if cfg.rollout.engine == "continuous":
+            from orion_tpu.parallel.sharding import ambient_mesh
             from orion_tpu.rollout.continuous import ContinuousBatchingEngine
 
+            # Sync-mode trainer built under `with mesh:` — give the
+            # engine the same mesh so its decode shards with the
+            # trainer's params instead of collapsing to one device.
+            m = ambient_mesh()
+            m = m if m is not None and not m.empty and m.size > 1 else None
             self.engine = ContinuousBatchingEngine(
                 model, cfg.model, cfg.rollout, eos_token_id=eos_token_id,
                 pad_token_id=pad_token_id,
-                segment_len=cfg.rollout.segment_len)
+                segment_len=cfg.rollout.segment_len, mesh=m)
         elif cfg.rollout.engine == "simple":
             self.engine = RolloutEngine(model, cfg.model, cfg.rollout,
                                         eos_token_id=eos_token_id,

@@ -51,6 +51,10 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "tpu: on-chip smoke test (runs only under "
         "`pytest -m tpu` / ORION_TEST_TPU=1 on a TPU box)")
+    config.addinivalue_line(
+        "markers", "smoke: fast pre-commit gate (`pytest -m smoke`, "
+        "<5 min) — the dryrun artifact + one bf16 test per parallelism "
+        "strategy + a tiny trainer loop; the full suite is the nightly")
 
 
 def pytest_collection_modifyitems(config, items):

@@ -1,0 +1,182 @@
+"""Tensor-sharded continuous engine (VERDICT r3 missing #2 / next #4):
+the decode twin's params shard via the tensor rules, the paged pools
+shard over kv-heads, and outputs match the single-device engines
+exactly — on the 8-fake-CPU-device harness."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from orion_tpu.config import MeshConfig, ModelConfig, RolloutConfig
+from orion_tpu.models import Transformer, init_params
+from orion_tpu.parallel.mesh import make_mesh
+from orion_tpu.rollout import RolloutEngine
+from orion_tpu.rollout.continuous import ContinuousBatchingEngine
+
+
+def _cfg():
+    # kv_heads divisible by tensor=2 so the pools really shard.
+    return ModelConfig.tiny(dtype="float32", num_heads=4, num_kv_heads=2)
+
+
+def _mk_engine(mesh=None, max_new=10, slots=2):
+    cfg = _cfg()
+    model = Transformer(cfg)
+    params = init_params(model, jax.random.key(0), cfg)
+    rcfg = RolloutConfig(max_prompt_len=12, max_new_tokens=max_new,
+                         temperature=0.0, page_size=4,
+                         max_batch_size=slots)
+    eng = ContinuousBatchingEngine(model, cfg, rcfg, eos_token_id=None,
+                                   segment_len=4, mesh=mesh)
+    return cfg, model, params, eng
+
+
+def _reqs(cfg, n=6, seed=0):
+    rng = np.random.RandomState(seed)
+    return [(i, rng.randint(1, cfg.vocab_size, rng.randint(3, 12)))
+            for i in range(n)]
+
+
+def test_sharded_engine_state_is_sharded():
+    mesh = make_mesh(MeshConfig(data=1, fsdp=1, seq=1, tensor=2),
+                     jax.devices()[:2])
+    cfg, model, params, eng = _mk_engine(mesh=mesh)
+    # pools sharded over kv-heads on the tensor axis
+    spec = eng._pools[0]["k_pages"].sharding.spec
+    assert len(spec) > 1 and spec[1] == "tensor", spec
+    # prepared params tensor-sharded across BOTH devices
+    eng.load_weights(params)
+    qk = eng._params["layers_0"]["attn"]["q_proj"]["kernel"]
+    assert len(qk.sharding.device_set) == 2, qk.sharding
+    assert "tensor" in str(qk.sharding.spec), qk.sharding.spec
+
+
+def test_sharded_matches_single_device():
+    """Greedy completions from the tensor=2 engine equal the
+    single-device engine's, request for request."""
+    cfg, model, params, solo_eng = _mk_engine(mesh=None)
+    mesh = make_mesh(MeshConfig(data=1, fsdp=1, seq=1, tensor=2),
+                     jax.devices()[:2])
+    _, _, _, tp_eng = _mk_engine(mesh=mesh)
+    reqs = _reqs(cfg)
+    out_solo = {r.req_id: r for r in
+                solo_eng.generate(reqs, jax.random.key(1), params)}
+    out_tp = {r.req_id: r for r in
+              tp_eng.generate(reqs, jax.random.key(1), params)}
+    assert sorted(out_tp) == sorted(out_solo)
+    for rid in out_solo:
+        np.testing.assert_array_equal(
+            out_tp[rid].tokens, out_solo[rid].tokens,
+            err_msg=f"req {rid}")
+        np.testing.assert_allclose(
+            out_tp[rid].logprobs, out_solo[rid].logprobs,
+            rtol=1e-4, atol=1e-5, err_msg=f"req {rid}")
+
+
+def test_sharded_matches_simple_engine_solo():
+    """Each tensor=2 continuous completion equals a solo run of the
+    SIMPLE engine (the cross-engine oracle the single-device continuous
+    tests use)."""
+    cfg, model, params, _ = _mk_engine(mesh=None)
+    mesh = make_mesh(MeshConfig(data=1, fsdp=1, seq=1, tensor=2),
+                     jax.devices()[:2])
+    _, _, _, tp_eng = _mk_engine(mesh=mesh)
+    solo = RolloutEngine(
+        model, cfg, RolloutConfig(max_new_tokens=10, temperature=0.0,
+                                  paged=True, page_size=4),
+        eos_token_id=None)
+    solo.load_weights(params)
+    reqs = _reqs(cfg, n=5, seed=3)
+    out = tp_eng.generate(reqs, jax.random.key(2), params)
+    for r in out:
+        ids = dict((i, v) for i, v in reqs)[r.req_id]
+        sr = solo.generate(jnp.asarray(np.asarray(ids)[None, :]),
+                           jnp.asarray([len(ids)], np.int32),
+                           jax.random.key(0))
+        n = int(sr.completion_lens[0])
+        np.testing.assert_array_equal(
+            r.tokens, np.asarray(sr.completions[0, :n]),
+            err_msg=f"req {r.req_id}")
+
+
+def test_sharded_quantized_weights():
+    """int8 weight-only decode under the tensor mesh: QuantDense params
+    carry the tensor sharding (ADVICE r3) and generation still matches
+    the unquantized greedy path on a tiny model."""
+    cfg = _cfg()
+    model = Transformer(cfg)
+    params = init_params(model, jax.random.key(0), cfg)
+    mesh = make_mesh(MeshConfig(data=1, fsdp=1, seq=1, tensor=2),
+                     jax.devices()[:2])
+    rcfg = RolloutConfig(max_prompt_len=12, max_new_tokens=8,
+                         temperature=0.0, page_size=4, max_batch_size=2,
+                         quantize_weights=True)
+    eng = ContinuousBatchingEngine(model, cfg, rcfg, eos_token_id=None,
+                                   segment_len=4, mesh=mesh)
+    eng.load_weights(params)
+    kq = eng._params["layers_0"]["attn"]["q_proj"]["kernel_q"]
+    assert kq.dtype == jnp.int8
+    assert len(kq.sharding.device_set) == 2, kq.sharding
+    reqs = _reqs(cfg, n=3, seed=5)
+    out = eng.generate(reqs, jax.random.key(1))
+    assert sorted(r.req_id for r in out) == [0, 1, 2]
+    for r in out:
+        assert len(r.tokens) == 8
+        assert np.isfinite(r.logprobs).all()
+
+
+def test_async_orchestrator_uses_full_rollout_group():
+    """engine='continuous' + async: the engine spans the WHOLE rollout
+    group (r3: it silently shrank to one device)."""
+    from orion_tpu.config import GRPOConfig
+    from orion_tpu.orchestration.async_orchestrator import (
+        AsyncOrchestrator, split_devices)
+    from orion_tpu.trainers import GRPOTrainer
+    from orion_tpu.models.sharded import make_sharded_model
+
+    rdev, tdev = split_devices(jax.devices(), 2)
+    tdev = tdev[:4]  # hidden=64 needs a power-of-2 fsdp degree
+    cfg = GRPOConfig()
+    cfg.model = _cfg()
+    cfg.rollout = RolloutConfig(max_prompt_len=8, max_new_tokens=8,
+                                temperature=1.0, page_size=4,
+                                max_batch_size=4, engine="continuous")
+    cfg.rollout_batch_size = 4
+    cfg.group_size = 2
+    cfg.minibatch_size = 8
+    cfg.num_epochs = 1
+    cfg.async_mode = True
+    cfg.async_staleness = 1
+
+    def reward_fn(result, batch):
+        toks = np.asarray(result.completions)
+        return (toks < 32).mean(axis=1).astype(np.float32)
+
+    tmesh = make_mesh(MeshConfig(data=1, fsdp=-1, seq=1, tensor=1),
+                      devices=tdev)
+    model = Transformer(cfg.model)
+    with tmesh:
+        params, _ = make_sharded_model(
+            model, tmesh, jax.random.key(0),
+            (jnp.zeros((1, 2), jnp.int32), jnp.zeros((1, 2), jnp.int32)))
+        trainer = GRPOTrainer(cfg, model, params, reward_fn=reward_fn,
+                              eos_token_id=None, pad_token_id=0)
+        orch = AsyncOrchestrator(trainer, rdev)
+        # the engine is sharded over BOTH rollout devices
+        assert orch.engine.mesh is not None
+        assert set(orch.engine.mesh.devices.flat) == set(rdev)
+        assert len(orch.engine._pools[0]["k_pages"]
+                   .sharding.device_set) == 2
+
+        rs = np.random.RandomState(0)
+        def batches(n):
+            for _ in range(n):
+                yield {"prompt_ids": rs.randint(
+                           2, cfg.model.vocab_size, (4, 8)).astype(np.int32),
+                       "prompt_lens": np.full((4,), 8, np.int32)}
+        hist = orch.train(batches(3), num_iterations=3)
+    assert len(hist) == 3
+    for h in hist:
+        assert 0 <= h["staleness"] <= 1
+        assert np.isfinite(h["loss"])

@@ -1,0 +1,141 @@
+"""Real-data path end-to-end (VERDICT r3 missing #3 / next #8): the
+four SPEC dataset adapters run on committed fixtures in the upstream
+HF schema, through a real HF tokenizer + chat template, and GSM8K
+drives one full GRPO iteration with the math-verifier reward."""
+
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from orion_tpu.data import build_prompt_iterator, load_tokenizer
+from orion_tpu.data.prompts import load_prompt_records, render_chat
+
+FIXTURES = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "fixtures")
+TOK_DIR = os.path.join(FIXTURES, "tokenizer")
+
+
+@pytest.fixture(scope="module")
+def hf_tok():
+    return load_tokenizer(TOK_DIR)
+
+
+@pytest.mark.parametrize("name", ["tldr", "hh", "ultrafeedback", "gsm8k"])
+def test_adapter_loads_fixture_rows(name):
+    recs = load_prompt_records(name, data_dir=FIXTURES)
+    assert len(recs) >= 30
+    for r in recs:
+        assert isinstance(r["prompt"], str) and r["prompt"]
+    if name == "gsm8k":
+        # the adapter extracted the '#### N' gold answers
+        assert all(float(r["answer"]) == int(r["answer"]) for r in recs)
+    if name == "hh":
+        # prompt ends at the final Assistant: turn (dialogue cut)
+        assert all(r["prompt"].endswith("Assistant:") for r in recs)
+
+
+def test_adapter_without_fixture_falls_back_to_hf(tmp_path):
+    """A dataset with no local jsonl falls through to the HF cache
+    route (so one config can mix fixture-backed and cached datasets);
+    on this zero-egress box that route fails loudly."""
+    with pytest.raises(RuntimeError, match="not available offline"):
+        load_prompt_records("tldr", data_dir=str(tmp_path))
+
+
+def test_adapter_refuses_bare_file_for_eval_split():
+    """{name}.jsonl serves split='train' ONLY — silently scoring an
+    eval on training prompts is the failure this guards."""
+    with pytest.raises(ValueError, match="train split"):
+        load_prompt_records("gsm8k", split="test", data_dir=FIXTURES)
+
+
+def test_adapter_split_suffixed_file(tmp_path):
+    import shutil
+
+    shutil.copy(os.path.join(FIXTURES, "gsm8k.jsonl"),
+                tmp_path / "gsm8k.test.jsonl")
+    recs = load_prompt_records("gsm8k", split="test",
+                               data_dir=str(tmp_path))
+    assert len(recs) >= 30
+
+
+@pytest.mark.parametrize("name", ["tldr", "hh", "ultrafeedback", "gsm8k"])
+def test_iterator_batches_with_hf_tokenizer(name, hf_tok):
+    it = build_prompt_iterator(name, hf_tok, batch_size=4,
+                               max_prompt_len=64, data_dir=FIXTURES,
+                               use_chat_template=(name != "tldr"))
+    batch = next(it)
+    assert batch["prompt_ids"].shape == (4, 64)
+    assert batch["prompt_ids"].dtype == np.int32
+    assert (batch["prompt_lens"] > 0).all()
+    assert batch["prompt_ids"].max() < hf_tok.vocab_size + 10
+    if name == "gsm8k":
+        assert "answer" in batch and len(batch["answer"]) == 4
+    # round-trip: the tokenized prompt decodes back to real words
+    row = batch["prompt_ids"][0][: batch["prompt_lens"][0]]
+    text = hf_tok.decode(row)
+    assert len(text.split()) > 3
+
+
+def test_chat_template_applied(hf_tok):
+    text = render_chat(hf_tok, "How many apples?", system="Be brief.")
+    assert "<|system|>" in text and "<|user|>" in text
+    assert text.rstrip().endswith("<|assistant|>")
+
+
+def test_gsm8k_grpo_iteration_with_math_verifier(hf_tok):
+    """One full GRPO iteration on the GSM8K fixture: adapter → chat
+    template → HF tokenizer → rollout → math verifier → update."""
+    from orion_tpu.config import GRPOConfig, ModelConfig
+    from orion_tpu.models import Transformer, init_params
+    from orion_tpu.rewards import MathVerifierReward
+    from orion_tpu.trainers import GRPOTrainer
+
+    cfg = GRPOConfig()
+    cfg.model = ModelConfig.tiny(vocab_size=512)
+    cfg.rollout.max_prompt_len = 64
+    cfg.rollout.max_new_tokens = 12
+    cfg.rollout_batch_size = 4
+    cfg.group_size = 2
+    cfg.minibatch_size = 8
+    cfg.num_epochs = 1
+    cfg.log_every = 0
+
+    model = Transformer(cfg.model)
+    params = init_params(model, jax.random.key(0), cfg.model)
+    reward = MathVerifierReward(hf_tok.batch_decode)
+    tr = GRPOTrainer(cfg, model, params, reward_fn=reward,
+                     eos_token_id=hf_tok.eos_token_id,
+                     pad_token_id=hf_tok.pad_token_id)
+    it = build_prompt_iterator("gsm8k", hf_tok, batch_size=4,
+                               max_prompt_len=64, data_dir=FIXTURES,
+                               use_chat_template=True)
+    hist = tr.train(it, num_iterations=1)
+    assert len(hist) == 1
+    assert np.isfinite(hist[0]["loss"])
+    # a random policy scores ~0, but the verifier must have RUN over
+    # real decoded text (reward_mean is a finite float in [0, 1])
+    assert 0.0 <= hist[0]["reward_mean"] <= 1.0
+
+
+def test_math_verifier_scores_correct_answer(hf_tok):
+    """The verifier credits a completion whose text contains the gold
+    '#### N' answer — closing the loop on decode→extract→compare."""
+    from orion_tpu.rewards import MathVerifierReward
+
+    recs = load_prompt_records("gsm8k", data_dir=FIXTURES)
+    gold = recs[0]["answer"]
+    good = hf_tok.encode(f"so #### {gold}")
+    bad = hf_tok.encode("so #### 999999")
+
+    class R:
+        completions = np.asarray([good, bad + [0] * (len(good) - len(bad))]
+                                 if len(bad) < len(good) else
+                                 [good + [0] * (len(bad) - len(good)), bad])
+        completion_lens = np.asarray([len(good), len(bad)])
+
+    reward = MathVerifierReward(hf_tok.batch_decode)
+    out = reward(R(), {"answer": np.asarray([gold, gold])})
+    assert out[0] == 1.0 and out[1] == 0.0

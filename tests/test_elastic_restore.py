@@ -1,0 +1,118 @@
+"""Elastic (cross-topology) restore (VERDICT r3 missing #5 / next #7):
+a checkpoint saved on an 8-device mesh restores onto 4- and 1-device
+meshes via the abstract-shardings path of utils.checkpoint — params
+bit-identical, and the restored trainer completes a further run.
+
+This is the TPU analogue of the reference stack's resume-on-a-
+different-world-size: Orbax re-chunks the arrays to whatever target
+shardings the restore template carries, so a slice-size change between
+runs costs nothing but the restore itself (SURVEY.md §5 failure
+detection / elastic recovery).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from orion_tpu.config import GRPOConfig, MeshConfig
+from orion_tpu.models import Transformer
+from orion_tpu.models.sharded import make_sharded_model, mesh_shardings_for
+from orion_tpu.parallel.mesh import make_mesh
+from orion_tpu.trainers import GRPOTrainer
+from orion_tpu.trainers.base import TrainState
+
+from test_trainers import lucky_token_reward, prompt_stream, tiny_model_cfg
+
+
+def _trainer_on(mesh, tmp_path, every=2):
+    cfg = GRPOConfig(model=tiny_model_cfg(), group_size=2, kl_coef=0.0,
+                     num_epochs=1, rollout_batch_size=8, minibatch_size=4,
+                     log_every=0, checkpoint_dir=str(tmp_path / "ckpt"),
+                     checkpoint_every=every)
+    model = Transformer(cfg.model)
+    init_args = (jnp.zeros((1, 2), jnp.int32), jnp.zeros((1, 2), jnp.int32))
+    with mesh:
+        params, _ = make_sharded_model(model, mesh, jax.random.key(0),
+                                       init_args)
+        tr = GRPOTrainer(cfg, model, params, reward_fn=lucky_token_reward,
+                         eos_token_id=None)
+    return cfg, model, tr
+
+
+def _abstract_state(state, model, mesh):
+    """TrainState template of ShapeDtypeStructs carrying the TARGET
+    mesh's shardings — the elastic-restore input."""
+    init_args = (jnp.zeros((1, 2), jnp.int32), jnp.zeros((1, 2), jnp.int32))
+    pshard = mesh_shardings_for(model, mesh, init_args)
+
+    def tmpl(x, sh):
+        return jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=sh)
+
+    params = jax.tree.map(tmpl, state.params, pshard)
+    # optimizer moments mirror the param tree; scalar counts replicate
+    rep = NamedSharding(mesh, P())
+
+    def opt_tmpl(x):
+        if not isinstance(x, jax.Array):
+            return x
+        if x.ndim == 0:
+            return jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=rep)
+        return jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=rep)
+
+    # match param-shaped opt leaves to the param shardings by shape
+    shard_by_shape = {}
+    for leaf, sh in zip(jax.tree.leaves(state.params),
+                        jax.tree.leaves(pshard)):
+        shard_by_shape[(leaf.shape, str(leaf.dtype))] = sh
+
+    def opt_leaf(x):
+        if not isinstance(x, jax.Array):
+            return x
+        sh = shard_by_shape.get((x.shape, str(x.dtype)), rep)
+        return jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=sh)
+
+    opt = jax.tree.map(opt_leaf, state.opt_state)
+    step = jax.ShapeDtypeStruct(state.step.shape, state.step.dtype,
+                                sharding=rep)
+    return TrainState(params=params, opt_state=opt, step=step)
+
+
+@pytest.mark.parametrize("target_devices", [4, 1])
+def test_elastic_restore_cross_mesh(tmp_path, target_devices):
+    devs = jax.devices()
+    mesh8 = make_mesh(MeshConfig(data=1, fsdp=4, seq=1, tensor=2),
+                      devs[:8])
+    cfg, model, tr = _trainer_on(mesh8, tmp_path)
+    with mesh8:
+        tr.train(prompt_stream(8, 5), num_iterations=2)
+    tr.ckpt.wait()
+    saved = jax.device_get(tr.state.params)
+
+    # Restore onto a smaller mesh via abstract shardings.
+    tgt_cfg = (MeshConfig(data=1, fsdp=2, seq=1, tensor=2)
+               if target_devices == 4 else
+               MeshConfig(data=1, fsdp=1, seq=1, tensor=1))
+    mesh_t = make_mesh(tgt_cfg, devs[:target_devices])
+    cfg2, model2, tr2 = _trainer_on(mesh_t, tmp_path)
+    with mesh_t:
+        tmpl = _abstract_state(tr2.state, model2, mesh_t)
+        out = tr2.ckpt.restore(step=2, state_template=tmpl)
+        tr2.state = out["state"]
+
+        # bit-identical params across the topology change
+        restored = jax.device_get(tr2.state.params)
+        for a, b in zip(jax.tree.leaves(restored), jax.tree.leaves(saved)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        # every restored leaf lives on the TARGET mesh
+        for leaf in jax.tree.leaves(tr2.state.params):
+            assert len(leaf.sharding.device_set) <= target_devices
+            assert set(d.id for d in leaf.sharding.device_set) <= \
+                set(d.id for d in mesh_t.devices.flat)
+
+        # and the restored trainer trains on the new topology
+        tr2.sync_weights()
+        hist = tr2.train(prompt_stream(8, 5), num_iterations=2)
+    assert len(hist) == 2
+    assert all(np.isfinite(h["loss"]) for h in hist)

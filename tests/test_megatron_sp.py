@@ -83,7 +83,8 @@ def test_sp_model_matches_dense(dtype):
     np.testing.assert_allclose(np.asarray(lg_sp), np.asarray(lg), **tol)
 
 
-@pytest.mark.parametrize("dtype", ["float32", "bfloat16"])
+@pytest.mark.parametrize("dtype", ["float32", pytest.param(
+    "bfloat16", marks=pytest.mark.smoke)])
 def test_sp_grads_match_dense(dtype):
     mesh = make_mesh(MeshConfig(data=1, fsdp=1, seq=1, tensor=8),
                      jax.devices()[:8])

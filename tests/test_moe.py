@@ -88,7 +88,8 @@ def test_moe_aux_loss_sown():
                           for x in leaves)
 
 
-@pytest.mark.parametrize("dtype", ["float32", "bfloat16"])
+@pytest.mark.parametrize("dtype", ["float32", pytest.param(
+    "bfloat16", marks=pytest.mark.smoke)])
 def test_moe_expert_parallel_parity(dtype):
     """Logits identical with experts sharded over the expert mesh axis
     (EP changes layout + collectives, not math).  bf16 variant guards

@@ -114,7 +114,8 @@ def test_pipeline_rejects_indivisible_layers():
         PipelinedTransformer(cfg, mesh)
 
 
-@pytest.mark.parametrize("dtype", ["float32", "bfloat16"])
+@pytest.mark.parametrize("dtype", ["float32", pytest.param(
+    "bfloat16", marks=pytest.mark.smoke)])
 def test_pipelined_training_step_matches_dense(dtype):
     """PP is TRAINABLE (VERDICT r2 missing #3): a full loss+backward+
     adamw step through the pipeline on a stage=2 x fsdp=2 x tensor=2

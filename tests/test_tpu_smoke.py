@@ -252,6 +252,106 @@ def test_continuous_engine_onchip():
     assert np.isfinite(np.asarray(out.logprobs)).all()
 
 
+def test_8b_int8_rollout_smoke_onchip():
+    """First measured 8B execution of any kind (VERDICT r3 missing #4):
+    llama3_8b with int8 weight-only decode (~8 GB weights) fits the
+    16 GB chip; generate a few dozen tokens and report tokens/s.
+
+    The decode-layout tree (int8 kernels + f32 scales + bf16
+    embeddings) is built DIRECTLY in its final dtypes on device — an
+    f32 master tree is 32 GB and can never exist on this chip — then
+    installed as the engine's prepped params (idempotent transforms:
+    quantize passes a kernel_q tree through untouched).
+    """
+    import dataclasses
+    import time
+
+    import flax.linen as nn
+
+    from orion_tpu.config import ModelConfig, RolloutConfig
+    from orion_tpu.models import Transformer
+    from orion_tpu.rollout.engine import RolloutEngine
+
+    mc = dataclasses.replace(ModelConfig.llama3_8b(), scan_layers=False)
+    rc = RolloutConfig(max_prompt_len=32, max_new_tokens=32,
+                       temperature=0.0, quantize_weights=True)
+    model = Transformer(mc)
+    eng = RolloutEngine(model, mc, rc, eos_token_id=None)
+
+    qshapes = nn.meta.unbox(jax.eval_shape(
+        lambda k: eng._decode_model.init(
+            k, jnp.zeros((1, 2), jnp.int32),
+            jnp.zeros((1, 2), jnp.int32))["params"], jax.random.key(0)))
+    flat, treedef = jax.tree_util.tree_flatten_with_path(qshapes)
+
+    def leaf(i, path, s):
+        k = jax.random.fold_in(jax.random.key(7), i)
+        names = [str(getattr(p, "key", p)) for p in path]
+        if s.dtype == jnp.int8:
+            return jax.random.randint(k, s.shape, -127, 128,
+                                      dtype=jnp.int8)
+        if names[-1] == "scale" and not any("norm" in n for n in names):
+            # QuantDense dequant scale: int8 * 1.6e-4 ≈ healthy 0.012
+            # weight std — random ±127 kernels with a too-large scale
+            # blow up bf16 activations through 32 layers.
+            return jnp.full(s.shape, 0.02 / 127.0, jnp.float32)
+        if names[-1] == "scale":
+            return jnp.ones(s.shape, jnp.float32)  # RMSNorm
+        return (jax.random.normal(k, s.shape, jnp.float32) * 0.02
+                ).astype(jnp.bfloat16)
+
+    params = jax.tree_util.tree_unflatten(
+        treedef, [leaf(i, p, s) for i, (p, s) in enumerate(flat)])
+    n_bytes = sum(x.nbytes for x in jax.tree.leaves(params))
+    eng.load_weights(params)
+
+    B = 8
+    ids = jnp.asarray(np.random.RandomState(0).randint(
+        2, mc.vocab_size, (B, 32)), jnp.int32)
+    lens = jnp.full((B,), 32, jnp.int32)
+    r = eng.generate(ids, lens, jax.random.key(1))     # compile + run
+    t0 = time.perf_counter()
+    r = eng.generate(ids, lens, jax.random.key(2))
+    lp = np.asarray(r.policy_logprobs)                 # real host sync
+    dt = time.perf_counter() - t0
+    assert np.isfinite(lp).all()
+    assert (np.asarray(r.completion_lens) == 32).all()
+    toks_per_sec = B * 32 / dt
+    print(f"[8b-smoke] {n_bytes/1e9:.1f} GB weights, "
+          f"{toks_per_sec:.1f} tok/s decode+prefill (B={B}, 32 new)")
+
+
+def test_continuous_sharded_mesh_onchip():
+    """The mesh code path of the continuous engine on real hardware
+    (sharded pool allocation, out_shardings prep, mesh-context decode
+    tracing).  One chip ⇒ tensor=1; the tensor>1 kernel split is
+    CPU-mesh-verified in tests/test_continuous_sharded.py."""
+    from orion_tpu.config import MeshConfig, RolloutConfig
+    from orion_tpu.models import Transformer, init_params
+    from orion_tpu.parallel.mesh import make_mesh
+    from orion_tpu.rollout.continuous import ContinuousBatchingEngine
+
+    cfg = _tiny_cfg()
+    model = Transformer(cfg)
+    params = init_params(model, jax.random.key(0), cfg)
+    mesh = make_mesh(MeshConfig(data=1, fsdp=1, seq=1, tensor=1),
+                     jax.devices()[:1])
+    rc = RolloutConfig(max_prompt_len=16, max_new_tokens=8,
+                       temperature=0.0, max_batch_size=4, page_size=8,
+                       segment_len=4)
+    eng = ContinuousBatchingEngine(model, cfg, rc, eos_token_id=None,
+                                   mesh=mesh)
+    plain = ContinuousBatchingEngine(model, cfg, rc, eos_token_id=None)
+    ids = np.random.RandomState(4).randint(2, cfg.vocab_size, (4, 16))
+    lens = np.full((4,), 16, np.int32)
+    a = eng.generate_batch(ids.astype(np.int32), lens, jax.random.key(5),
+                           params=params)
+    b = plain.generate_batch(ids.astype(np.int32), lens,
+                             jax.random.key(5), params=params)
+    np.testing.assert_array_equal(np.asarray(a.completions),
+                                  np.asarray(b.completions))
+
+
 def test_ppo_micro_run_onchip():
     """Two full PPO iterations (generate → score → experience → update)
     on the chip, shared trunk, flash attention, scatter cache write,

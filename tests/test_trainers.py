@@ -65,6 +65,7 @@ def _policy():
     return model, params
 
 
+@pytest.mark.smoke
 def test_grpo_reward_goes_up():
     cfg = _mk(GRPOConfig, group_size=4, kl_coef=0.0, num_epochs=1)
     model, params = _policy()
