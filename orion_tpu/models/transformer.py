@@ -160,7 +160,9 @@ class Attention(nn.Module):
                     paged_decode_attention_sharded)
                 paged_decode_out = paged_decode_attention_sharded(
                     q[:, 0], new_cache["k_pages"], new_cache["v_pages"],
-                    new_cache["block_tables"], positions[:, 0] + 1, scale)
+                    new_cache["block_tables"], positions[:, 0] + 1, scale,
+                    k_scales=new_cache.get("k_scales"),
+                    v_scales=new_cache.get("v_scales"))
                 keys = values = None
             else:
                 # Prefill (full or chunked): gather the sequence's pages
@@ -169,7 +171,7 @@ class Attention(nn.Module):
                 # in-chunk keys alike.  (Gather cost ≈ the dense cache;
                 # paged wins on the decode side, same trade vLLM makes.)
                 from orion_tpu.ops.paged_kv import gather_paged_kv
-                keys, values = gather_paged_kv(new_cache)
+                keys, values = gather_paged_kv(new_cache, _dt(cfg.dtype))
         elif layer_cache is not None:
             starts = positions[:, 0]
 

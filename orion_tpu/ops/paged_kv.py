@@ -23,11 +23,18 @@ import jax.numpy as jnp
 def init_paged_cache(num_layers: int, batch: int, max_len: int,
                      num_kv_heads: int, head_dim: int, page_size: int,
                      num_pages: int = 0, dtype=jnp.bfloat16,
-                     stacked: bool = False):
+                     stacked: bool = False, quantized: bool = False):
     """Per-layer {"k_pages", "v_pages", "block_tables"} with a contiguous
     block-table assignment.  max_len is rounded up to whole pages.
     ``stacked=True`` (scan_layers models) returns one pytree with a
-    leading [num_layers] axis instead of a per-layer list."""
+    leading [num_layers] axis instead of a per-layer list.
+
+    ``quantized=True``: int8 pools + per-(token, head) f32 scale pools
+    "k_scales"/"v_scales" of shape [num_pages, Hkv, 1, page_size] — the
+    trailing page_size axis keeps the Pallas scale block 2-D ([1, ps])
+    in the decode kernel, which is the Mosaic-friendly layout.  Halves
+    the pool's HBM footprint AND the per-decode-step pool read
+    bandwidth (the usual decode bottleneck)."""
     pages_per_seq = -(-max_len // page_size)
     if num_pages <= 0:
         num_pages = batch * pages_per_seq
@@ -37,16 +44,22 @@ def init_paged_cache(num_layers: int, batch: int, max_len: int,
     bt = (jnp.arange(batch, dtype=jnp.int32)[:, None] * pages_per_seq
           + jnp.arange(pages_per_seq, dtype=jnp.int32)[None, :])
     shape = (num_pages, num_kv_heads, page_size, head_dim)
+    sshape = (num_pages, num_kv_heads, 1, page_size)
+    pool_dtype = jnp.int8 if quantized else dtype
+
+    def layer(pre=()):
+        out = {"k_pages": jnp.zeros(pre + shape, pool_dtype),
+               "v_pages": jnp.zeros(pre + shape, pool_dtype)}
+        if quantized:
+            out["k_scales"] = jnp.zeros(pre + sshape, jnp.float32)
+            out["v_scales"] = jnp.zeros(pre + sshape, jnp.float32)
+        return out
+
     if stacked:
-        stk = (num_layers,) + shape
-        return {"k_pages": jnp.zeros(stk, dtype),
-                "v_pages": jnp.zeros(stk, dtype),
+        return {**layer((num_layers,)),
                 "block_tables": jnp.broadcast_to(
                     bt, (num_layers,) + bt.shape)}
-    return [{"k_pages": jnp.zeros(shape, dtype),
-             "v_pages": jnp.zeros(shape, dtype),
-             "block_tables": bt}
-            for _ in range(num_layers)]
+    return [{**layer(), "block_tables": bt} for _ in range(num_layers)]
 
 
 def write_paged_tokens(layer_cache: dict, k_new: jnp.ndarray,
@@ -62,13 +75,31 @@ def write_paged_tokens(layer_cache: dict, k_new: jnp.ndarray,
     page_size = layer_cache["k_pages"].shape[2]
     pages = jnp.take_along_axis(bt, positions // page_size, axis=1)  # [B, L]
     slots = positions % page_size                                     # [B, L]
+    if "k_scales" in layer_cache:
+        # int8 pools: quantize per (token, head) over D, scatter values
+        # and scales (scale pools are [N, Hkv, 1, ps]).
+        from orion_tpu.ops.quant import quantize_kv
+
+        kq, ks = quantize_kv(k_new)          # [B,L,Hkv,D], [B,L,Hkv]
+        vq, vs = quantize_kv(v_new)
+        return {
+            "k_pages": layer_cache["k_pages"].at[pages, :, slots, :]
+            .set(kq),
+            "v_pages": layer_cache["v_pages"].at[pages, :, slots, :]
+            .set(vq),
+            "k_scales": layer_cache["k_scales"].at[pages, :, 0, slots]
+            .set(ks),
+            "v_scales": layer_cache["v_scales"].at[pages, :, 0, slots]
+            .set(vs),
+            "block_tables": bt,
+        }
     # k_pages[pages, :, slots, :] selects [B, L, Hkv, D] — matching k_new.
     k_pages = layer_cache["k_pages"].at[pages, :, slots, :].set(k_new)
     v_pages = layer_cache["v_pages"].at[pages, :, slots, :].set(v_new)
     return {"k_pages": k_pages, "v_pages": v_pages, "block_tables": bt}
 
 
-def gather_paged_kv(layer_cache: dict) -> tuple:
+def gather_paged_kv(layer_cache: dict, dtype=jnp.bfloat16) -> tuple:
     """Gather each sequence's pages into slot order: returns
     (k, v) [B, max_pages*page_size, Hkv, D] where slot j holds the
     token at absolute position j (zero pages where unwritten).  Used by
@@ -81,7 +112,19 @@ def gather_paged_kv(layer_cache: dict) -> tuple:
         g = jnp.take(pages, bt, axis=0)             # [B, mp, Hkv, ps, D]
         return g.transpose(0, 1, 3, 2, 4).reshape(B, max_pages * ps, Hkv, D)
 
-    return gather(layer_cache["k_pages"]), gather(layer_cache["v_pages"])
+    k, v = gather(layer_cache["k_pages"]), gather(layer_cache["v_pages"])
+    if "k_scales" in layer_cache:
+        # int8 pools: dequantize on the (once-per-generate) prefill
+        # gather — XLA fuses the convert+mul into the attention reads.
+        def gather_s(scales):                       # [N, Hkv, 1, ps]
+            g = jnp.take(scales[:, :, 0, :], bt, axis=0)  # [B, mp, Hkv, ps]
+            return g.transpose(0, 1, 3, 2).reshape(B, max_pages * ps, Hkv)
+
+        k = (k.astype(jnp.float32) * gather_s(
+            layer_cache["k_scales"])[..., None]).astype(dtype)
+        v = (v.astype(jnp.float32) * gather_s(
+            layer_cache["v_scales"])[..., None]).astype(dtype)
+    return k, v
 
 
 def is_paged(layer_cache: Optional[dict]) -> bool:

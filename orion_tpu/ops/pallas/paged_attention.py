@@ -13,6 +13,14 @@ Online softmax accumulates across page-slots in VMEM scratch (the grid's
 innermost dimension is sequential on TPU, so scratch persists).  The
 page index map clamps to the last in-use page, so the masked tail of the
 block table costs no HBM bandwidth however it is padded.
+
+ONE kernel serves the bf16 and int8 pools: with ``quantized=True`` the
+K/V pages arrive int8 with per-(slot, head) f32 scale operands ([1, ps]
+blocks — see ops.paged_kv.init_paged_cache).  The K scale lands on the
+scores and the V scale folds into the probs — both [1, ps] — so the big
+page operands enter the dots as bare int8→f32 converts that fuse into
+the reads (same recipe as the dense int8 cache in ops/attention.py),
+and HBM moves 1 byte per cache element.
 """
 
 from __future__ import annotations
@@ -28,8 +36,13 @@ from orion_tpu.ops.pallas import NEG_INF as _NEG_INF
 from orion_tpu.ops.pallas import interpret_mode as _interpret
 
 
-def _decode_kernel(bt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
-                   m_sc, l_sc, acc_sc, *, scale: float, page_size: int):
+def _decode_kernel(bt_ref, len_ref, q_ref, *refs, scale: float,
+                   page_size: int, quantized: bool):
+    if quantized:
+        k_ref, ks_ref, v_ref, vs_ref, o_ref, m_sc, l_sc, acc_sc = refs
+    else:
+        k_ref, v_ref, o_ref, m_sc, l_sc, acc_sc = refs
+        ks_ref = vs_ref = None
     b = pl.program_id(0)
     j = pl.program_id(2)
     last = pl.num_programs(2) - 1
@@ -49,6 +62,8 @@ def _decode_kernel(bt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)                  # [1, ps]
+        if ks_ref is not None:
+            s = s * ks_ref[0, 0, :, :]                           # [1, ps]
         idx = j * page_size + jax.lax.broadcasted_iota(
             jnp.int32, (1, page_size), 1)
         s = jnp.where(idx < seq_len, s, _NEG_INF)
@@ -59,6 +74,8 @@ def _decode_kernel(bt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
         alpha = jnp.exp(m_prev - m_new)
         m_sc[:, :] = m_new
         l_sc[:, :] = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        if vs_ref is not None:
+            p = p * vs_ref[0, 0, :, :]
         acc_sc[:, :] = acc_sc[:, :] * alpha + jnp.dot(
             p, v, preferred_element_type=jnp.float32)            # [1, D]
 
@@ -70,12 +87,15 @@ def _decode_kernel(bt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
 
 def paged_decode_attention(q: jnp.ndarray, k_pages: jnp.ndarray,
                            v_pages: jnp.ndarray, block_tables: jnp.ndarray,
-                           seq_lens: jnp.ndarray, scale: float) -> jnp.ndarray:
+                           seq_lens: jnp.ndarray, scale: float,
+                           k_scales=None, v_scales=None) -> jnp.ndarray:
     """One decode step of attention over a paged KV pool.
 
     q: [B, H, D] (current token per sequence);
     k_pages/v_pages: [num_pages, Hkv, page_size, D] global pool (heads
-      before slots so page blocks tile as (slots, head_dim) on the MXU);
+      before slots so page blocks tile as (slots, head_dim) on the MXU),
+      bf16/f32 — or int8 when ``k_scales``/``v_scales`` (f32
+      [num_pages, Hkv, 1, page_size]) are given;
     block_tables: [B, max_pages] int32, entry j = pool page holding
       tokens [j*page_size, (j+1)*page_size) of that sequence;
     seq_lens: [B] int32 — number of valid tokens (inclusive of the
@@ -85,6 +105,7 @@ def paged_decode_attention(q: jnp.ndarray, k_pages: jnp.ndarray,
     _, Hkv, page_size, _ = k_pages.shape
     max_pages = block_tables.shape[1]
     n_rep = H // Hkv
+    quantized = k_scales is not None
     q4 = q[:, :, None, :]                                     # [B, H, 1, D]
 
     def page_map(b, h, j, bt, ln, r=n_rep, ps=page_size):
@@ -94,14 +115,26 @@ def paged_decode_attention(q: jnp.ndarray, k_pages: jnp.ndarray,
         last = jnp.maximum(ln[b] - 1, 0) // ps
         return (bt[b, jnp.minimum(j, last)], h // r, 0, 0)
 
+    page_spec = pl.BlockSpec((1, 1, page_size, D), page_map)
+    scale_spec = pl.BlockSpec((1, 1, 1, page_size), page_map)
+    in_specs = [
+        pl.BlockSpec((1, 1, 1, D), lambda b, h, j, bt, ln: (b, h, 0, 0)),
+        page_spec,
+    ]
+    operands = [q4, k_pages]
+    if quantized:
+        in_specs.append(scale_spec)
+        operands.append(k_scales)
+    in_specs.append(page_spec)
+    operands.append(v_pages)
+    if quantized:
+        in_specs.append(scale_spec)
+        operands.append(v_scales)
+
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(B, H, max_pages),
-        in_specs=[
-            pl.BlockSpec((1, 1, 1, D), lambda b, h, j, bt, ln: (b, h, 0, 0)),
-            pl.BlockSpec((1, 1, page_size, D), page_map),
-            pl.BlockSpec((1, 1, page_size, D), page_map),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, 1, 1, D),
                                lambda b, h, j, bt, ln: (b, h, 0, 0)),
         scratch_shapes=[
@@ -111,48 +144,75 @@ def paged_decode_attention(q: jnp.ndarray, k_pages: jnp.ndarray,
         ],
     )
     out = pl.pallas_call(
-        functools.partial(_decode_kernel, scale=scale, page_size=page_size),
+        functools.partial(_decode_kernel, scale=scale,
+                          page_size=page_size, quantized=quantized),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((B, H, 1, D), q.dtype),
         interpret=_interpret(),
-    )(block_tables, seq_lens, q4, k_pages, v_pages)
+    )(block_tables, seq_lens, *operands)
     return out[:, :, 0, :]
 
 
+def paged_decode_attention_int8(q, k_pages, v_pages, k_scales, v_scales,
+                                block_tables, seq_lens, scale: float):
+    """int8-pool entry point (scales REQUIRED); thin delegation to
+    :func:`paged_decode_attention`."""
+    return paged_decode_attention(q, k_pages, v_pages, block_tables,
+                                  seq_lens, scale, k_scales=k_scales,
+                                  v_scales=v_scales)
+
+
 def paged_decode_attention_sharded(q, k_pages, v_pages, block_tables,
-                                   seq_lens, scale: float):
+                                   seq_lens, scale: float,
+                                   k_scales=None, v_scales=None):
     """Tensor-parallel paged decode (VERDICT r3 missing #2).
 
     When the ambient mesh has a tensor axis that divides both head
     counts, the kernel runs inside a nested ``shard_map`` over that
-    axis: each device holds its kv-head slice of the page pools and its
-    (contiguous, kv-head-major) q-head slice, block tables and lengths
-    replicate, and NO pool gather ever happens — the pallas_call is
-    opaque to GSPMD, which would otherwise all-gather the entire KV
-    pool every decode step.  The local ``h // n_rep`` GQA mapping stays
-    correct because both H and Hkv are sliced proportionally.  Falls
-    back to the plain kernel outside a mesh (single-chip engines) or
-    when the axis doesn't divide the heads.
+    axis: each device holds its kv-head slice of the page pools (and
+    scale pools, for int8) and its (contiguous, kv-head-major) q-head
+    slice, block tables and lengths replicate, and NO pool gather ever
+    happens — the pallas_call is opaque to GSPMD, which would otherwise
+    all-gather the entire KV pool every decode step.  The local
+    ``h // n_rep`` GQA mapping stays correct because both H and Hkv are
+    sliced proportionally.  Falls back to the plain kernel outside a
+    mesh (single-chip engines) or when the axis doesn't divide the
+    heads.
     """
     from orion_tpu.parallel.sharding import ambient_mesh
 
     B, H, D = q.shape
     Hkv = k_pages.shape[1]
+    quantized = k_scales is not None
     mesh = ambient_mesh()
     tp = 0 if mesh is None or mesh.empty else \
         dict(mesh.shape).get("tensor", 1)
     if tp <= 1 or H % tp or Hkv % tp:
         return paged_decode_attention(q, k_pages, v_pages, block_tables,
-                                      seq_lens, scale)
+                                      seq_lens, scale, k_scales=k_scales,
+                                      v_scales=v_scales)
     from jax import shard_map
     from jax.sharding import PartitionSpec as P
 
+    pool_spec = P(None, "tensor", None, None)
+    args = [q, k_pages, v_pages]
+    specs = [P(None, "tensor", None), pool_spec, pool_spec]
+    if quantized:
+        args += [k_scales, v_scales]
+        specs += [pool_spec, pool_spec]
+    args += [block_tables, seq_lens]
+    specs += [P(), P()]
+
+    def body(q_, kp, vp, *rest):
+        if quantized:
+            ks, vs, bt, ln = rest
+        else:
+            (bt, ln), ks, vs = rest, None, None
+        return paged_decode_attention(q_, kp, vp, bt, ln, scale,
+                                      k_scales=ks, v_scales=vs)
+
     mapped = shard_map(
-        lambda q_, kp, vp, bt, ln: paged_decode_attention(
-            q_, kp, vp, bt, ln, scale),
-        mesh=mesh,
-        in_specs=(P(None, "tensor", None), P(None, "tensor", None, None),
-                  P(None, "tensor", None, None), P(), P()),
+        body, mesh=mesh, in_specs=tuple(specs),
         out_specs=P(None, "tensor", None),
         axis_names={"tensor"}, check_vma=False)
-    return mapped(q, k_pages, v_pages, block_tables, seq_lens)
+    return mapped(*args)

@@ -71,11 +71,6 @@ class ContinuousBatchingEngine:
         # model; the scan-layout original is deliberately NOT kept —
         # the per-layer pools below match the unrolled cache layout.
         self._decode_model, dcfg = make_decode_twin(model, model_cfg)
-        if cfg.quantize_kv:
-            raise ValueError(
-                "quantize_kv covers the RolloutEngine dense cache only; "
-                "the continuous engine's paged pools read bf16 pages "
-                "(set quantize_kv=False for engine='continuous')")
         if cfg.quantize_weights:
             import dataclasses as _dc
 
@@ -95,9 +90,20 @@ class ContinuousBatchingEngine:
         self._scratch = self.num_pages
         shape = (self.num_pages + 1, model_cfg.num_kv_heads, ps,
                  model_cfg.head_dim)
-        dt = jnp.dtype(model_cfg.dtype)
+        sshape = (self.num_pages + 1, model_cfg.num_kv_heads, 1, ps)
+        dt = jnp.int8 if cfg.quantize_kv else jnp.dtype(model_cfg.dtype)
+
         # Pools always use the unrolled per-layer layout: decode runs
         # through the unrolled twin regardless of cfg.scan_layers.
+        # One layout definition, parameterized over the allocator (the
+        # mesh branch allocates directly sharded).
+        def pool(alloc_kv, alloc_scale):
+            out = {"k_pages": alloc_kv(), "v_pages": alloc_kv()}
+            if cfg.quantize_kv:
+                out["k_scales"] = alloc_scale()
+                out["v_scales"] = alloc_scale()
+            return out
+
         if mesh is not None:
             tp = dict(mesh.shape).get("tensor", 1)
             if tp > 1 and model_cfg.num_kv_heads % tp:
@@ -118,7 +124,9 @@ class ContinuousBatchingEngine:
                        model_cfg.num_kv_heads % tp == 0 else P())
             mk = jax.jit(lambda: jnp.zeros(shape, dt),
                          out_shardings=NamedSharding(mesh, kv_spec))
-            self._pools = [{"k_pages": mk(), "v_pages": mk()}
+            mks = jax.jit(lambda: jnp.zeros(sshape, jnp.float32),
+                          out_shardings=NamedSharding(mesh, kv_spec))
+            self._pools = [pool(mk, mks)
                            for _ in range(model_cfg.num_layers)]
             from orion_tpu.models.sharded import mesh_shardings_for
 
@@ -127,8 +135,8 @@ class ContinuousBatchingEngine:
             self._param_shardings = mesh_shardings_for(
                 self._decode_model, mesh, init_args)
         else:
-            self._pools = [{"k_pages": jnp.zeros(shape, dt),
-                            "v_pages": jnp.zeros(shape, dt)}
+            self._pools = [pool(partial(jnp.zeros, shape, dt),
+                                partial(jnp.zeros, sshape, jnp.float32))
                            for _ in range(model_cfg.num_layers)]
             self._param_shardings = None
         self._bt = np.full((self.slots, self.pages_per_seq), self._scratch,
@@ -224,12 +232,11 @@ class ContinuousBatchingEngine:
 
     # -- jitted programs ------------------------------------------------
     def _cache(self, pools, bt):
-        return [{"k_pages": p["k_pages"], "v_pages": p["v_pages"],
-                 "block_tables": bt} for p in pools]
+        return [{**p, "block_tables": bt} for p in pools]
 
     def _strip(self, cache):
         """Drop block tables from the post-apply cache → pool state."""
-        return [{"k_pages": c["k_pages"], "v_pages": c["v_pages"]}
+        return [{k: v for k, v in c.items() if k != "block_tables"}
                 for c in cache]
 
     def _prefill_fn(self, params, pools, bt_rows, prompt_ids, prompt_lens,

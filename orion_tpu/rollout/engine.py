@@ -76,11 +76,6 @@ class RolloutEngine:
 
         self._decode_model, self._decode_cfg = make_decode_twin(
             model, model_cfg)
-        if cfg.quantize_kv and cfg.paged:
-            raise ValueError(
-                "quantize_kv currently covers the dense cache only; "
-                "the paged Pallas kernel reads bf16 pages "
-                "(use paged=False or quantize_kv=False)")
         if cfg.quantize_weights:
             # int8 decode twin (ops/quant.py): same architecture, Dense
             # layers read int8 kernels.  Params are quantized inside
@@ -146,7 +141,8 @@ class RolloutEngine:
             cache = init_paged_cache(
                 mc.num_layers, B, P + T, mc.num_kv_heads, mc.head_dim,
                 cfg.page_size, cfg.num_pages,
-                dtype=jnp.dtype(mc.dtype), stacked=mc.scan_layers)
+                dtype=jnp.dtype(mc.dtype), stacked=mc.scan_layers,
+                quantized=cfg.quantize_kv)
         else:
             cache = init_cache(self._decode_cfg, B, P + T,
                                dtype=jnp.dtype(self._decode_cfg.dtype),

@@ -114,3 +114,50 @@ def test_paged_decode_sharded_falls_back_outside_mesh():
     out = paged_decode_attention_sharded(q, kp, vp, bt, lens, 0.25)
     ref = paged_decode_attention(q, kp, vp, bt, lens, 0.25)
     np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
+def test_paged_decode_int8_matches_dequant_dense():
+    """int8-pool kernel == dense reference over the DEQUANTIZED pool
+    (same values, so tolerance is rounding-level, not quantization-
+    level)."""
+    from orion_tpu.ops.pallas.paged_attention import (
+        paged_decode_attention_int8)
+    from orion_tpu.ops.quant import quantize_kv
+
+    q, kp, vp, bt, lens = _setup(seed=4)
+    kq, ks = quantize_kv(kp)          # [N,Hkv,ps,D], [N,Hkv,ps]
+    vq, vs = quantize_kv(vp)
+    ks4, vs4 = ks[:, :, None, :], vs[:, :, None, :]
+    out = paged_decode_attention_int8(q, kq, vq, ks4, vs4, bt, lens, 0.25)
+    kd = np.asarray(kq, np.float32) * np.asarray(ks)[..., None]
+    vd = np.asarray(vq, np.float32) * np.asarray(vs)[..., None]
+    ref = _dense_ref(q, jnp.asarray(kd), jnp.asarray(vd), bt, lens, 0.25)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_paged_decode_int8_sharded_matches_plain():
+    from orion_tpu.config import MeshConfig
+    from orion_tpu.ops.pallas.paged_attention import (
+        paged_decode_attention_int8, paged_decode_attention_sharded)
+    from orion_tpu.ops.quant import quantize_kv
+    from orion_tpu.parallel.mesh import make_mesh
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    q, kp, vp, bt, lens = _setup(H=4, Hkv=2, seed=5)
+    kq, ks = quantize_kv(kp)
+    vq, vs = quantize_kv(vp)
+    ks4, vs4 = ks[:, :, None, :], vs[:, :, None, :]
+    plain = paged_decode_attention_int8(q, kq, vq, ks4, vs4, bt, lens,
+                                        0.25)
+    mesh = make_mesh(MeshConfig(data=1, fsdp=1, seq=1, tensor=2),
+                     jax.devices()[:2])
+    sh = NamedSharding(mesh, P(None, "tensor"))
+    with mesh:
+        out = jax.jit(lambda *a: paged_decode_attention_sharded(
+            *a, 0.25, k_scales=jax.device_put(ks4, sh),
+            v_scales=jax.device_put(vs4, sh)))(
+                q, jax.device_put(kq, sh), jax.device_put(vq, sh),
+                bt, lens)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(plain),
+                               rtol=2e-5, atol=2e-5)

@@ -321,6 +321,41 @@ def test_8b_int8_rollout_smoke_onchip():
           f"{toks_per_sec:.1f} tok/s decode+prefill (B={B}, 32 new)")
 
 
+def test_paged_decode_int8_onchip():
+    """int8-pool paged decode kernel Mosaic-compiles and matches the
+    dequantized-dense oracle on real hardware (the scale blocks are
+    [1, page_size] VMEM tiles — the lane-rule class that only Mosaic
+    can validate)."""
+    from orion_tpu.ops.pallas.paged_attention import (
+        paged_decode_attention_int8)
+    from orion_tpu.ops.quant import quantize_kv
+
+    B, H, Hkv, D, ps, npages = 4, 8, 4, 64, 16, 24
+    seq_lens = jnp.asarray([33, 48, 17, 40], jnp.int32)
+    max_pages = 3
+    rng = np.random.RandomState(3)
+    kp = jnp.asarray(rng.randn(npages, Hkv, ps, D), jnp.float32)
+    vp = jnp.asarray(rng.randn(npages, Hkv, ps, D), jnp.float32)
+    bt = jnp.asarray(rng.permutation(npages)[: B * max_pages].reshape(
+        B, max_pages), jnp.int32)
+    q = jnp.asarray(rng.randn(B, H, D), BF16)
+    kq, ks = quantize_kv(kp)
+    vq, vs = quantize_kv(vp)
+    out = jax.jit(lambda q: paged_decode_attention_int8(
+        q, kq, vq, ks[:, :, None, :], vs[:, :, None, :], bt, seq_lens,
+        0.125))(q)
+    assert np.isfinite(np.asarray(out, np.float32)).all()
+    # oracle: bf16 kernel over the dequantized pool
+    from orion_tpu.ops.pallas.paged_attention import paged_decode_attention
+    kd = (kq.astype(jnp.float32) * ks[..., None]).astype(BF16)
+    vd = (vq.astype(jnp.float32) * vs[..., None]).astype(BF16)
+    ref = jax.jit(lambda q: paged_decode_attention(
+        q, kd, vd, bt, seq_lens, 0.125))(q)
+    np.testing.assert_allclose(np.asarray(out, np.float32),
+                               np.asarray(ref, np.float32),
+                               rtol=3e-2, atol=3e-2)
+
+
 def test_continuous_sharded_mesh_onchip():
     """The mesh code path of the continuous engine on real hardware
     (sharded pool allocation, out_shardings prep, mesh-context decode
