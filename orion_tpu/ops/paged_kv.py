@@ -116,14 +116,14 @@ def gather_paged_kv(layer_cache: dict, dtype=jnp.bfloat16) -> tuple:
     if "k_scales" in layer_cache:
         # int8 pools: dequantize on the (once-per-generate) prefill
         # gather — XLA fuses the convert+mul into the attention reads.
+        from orion_tpu.ops.quant import dequant_kv
+
         def gather_s(scales):                       # [N, Hkv, 1, ps]
             g = jnp.take(scales[:, :, 0, :], bt, axis=0)  # [B, mp, Hkv, ps]
             return g.transpose(0, 1, 3, 2).reshape(B, max_pages * ps, Hkv)
 
-        k = (k.astype(jnp.float32) * gather_s(
-            layer_cache["k_scales"])[..., None]).astype(dtype)
-        v = (v.astype(jnp.float32) * gather_s(
-            layer_cache["v_scales"])[..., None]).astype(dtype)
+        k = dequant_kv(k, gather_s(layer_cache["k_scales"]), dtype)
+        v = dequant_kv(v, gather_s(layer_cache["v_scales"]), dtype)
     return k, v
 
 
