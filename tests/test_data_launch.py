@@ -121,3 +121,40 @@ def test_launch_grpo_gsm8k_fixtures(tmp_path):
     for h in history:
         assert np.isfinite(h["loss"])
         assert 0.0 <= h["reward_mean"] <= 1.0
+
+
+def test_launch_ppo_with_hf_reward_model(tmp_path):
+    """The SPEC-config-2 CLI path offline: reward=model:<path> loads a
+    real HF sequence-classification checkpoint (built tiny with torch,
+    saved safetensors), the launcher shards it on the mesh and scores
+    on-device through ModelReward — config → trainer → 2 iterations."""
+    torch = pytest.importorskip("torch")
+    from transformers import LlamaConfig, LlamaForSequenceClassification
+
+    from orion_tpu.launch import main
+
+    hf_cfg = LlamaConfig(
+        vocab_size=260, hidden_size=64, intermediate_size=112,
+        num_hidden_layers=2, num_attention_heads=4,
+        num_key_value_heads=2, max_position_embeddings=128,
+        rms_norm_eps=1e-5, rope_theta=10000.0,
+        tie_word_embeddings=False, attention_bias=False, num_labels=1,
+        pad_token_id=0)
+    torch.manual_seed(3)
+    rm_dir = str(tmp_path / "rm")
+    LlamaForSequenceClassification(hf_cfg).eval().save_pretrained(rm_dir)
+
+    history = main([
+        "ppo",
+        "model.vocab_size=260", "model.hidden_size=32",
+        "model.intermediate_size=64", "model.num_layers=2",
+        "model.num_heads=4", "model.num_kv_heads=2", "model.dtype=float32",
+        "share_backbone=true", f"reward=model:{rm_dir}",
+        "rollout.max_new_tokens=8", "rollout.max_prompt_len=32",
+        "rollout_batch_size=4", "minibatch_size=4",
+        "total_iterations=2", "optimizer.learning_rate=1e-4",
+        "log_every=0",
+    ])
+    assert len(history) == 2
+    for h in history:
+        assert np.isfinite(h["loss"]) and np.isfinite(h["reward_mean"])

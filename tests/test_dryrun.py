@@ -29,5 +29,5 @@ def test_dryrun_multichip_8():
         cwd=REPO, env=env, capture_output=True, text=True, timeout=600)
     assert r.returncode == 0, f"stdout:\n{r.stdout}\nstderr:\n{r.stderr}"
     assert "8b=compiled ok" in r.stdout
-    # every parallelism leg actually ran (pp/sp/ep enabled at n=8)
-    assert "sp=2 pp=2 ep=2" in r.stdout
+    # every leg actually ran (pp/sp/ep/continuous-engine at n=8)
+    assert "sp=2 pp=2 ep=2 ce=2" in r.stdout

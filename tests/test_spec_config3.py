@@ -115,3 +115,4 @@ def test_rloo_ultrafeedback_with_rm():
     first = np.mean([h["reward_mean"] for h in hist[:2]])
     last = np.mean([h["reward_mean"] for h in hist[-2:]])
     assert last > first, (first, last)
+    assert all(np.isfinite(h["loss"]) for h in hist)
