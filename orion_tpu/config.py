@@ -209,6 +209,14 @@ class RolloutConfig:
     temperature: float = 1.0
     top_k: int = 0  # 0 => disabled
     top_p: float = 1.0  # 1.0 => disabled
+    # EOS is suppressed until each sequence has generated this many
+    # tokens (vLLM min_tokens / HF min_new_tokens).
+    min_new_tokens: int = 0
+    # HF/vLLM repetition penalty over prompt+generated tokens; 1.0 =>
+    # disabled (no [B, V] seen-mask state is carried when off).  Must
+    # be > 0 — NOT the top_k-style "0 disables" convention (0 would
+    # divide logits by zero); validated in __post_init__.
+    repetition_penalty: float = 1.0
     # Paged KV cache for RolloutEngine: capacity in pages; page_size
     # tokens per page.  Default False: for fixed-batch generate the
     # dense cache is ~2.6x faster on-chip (measured v5e, B=32/L=256 —
@@ -238,6 +246,17 @@ class RolloutConfig:
     # training graph is never quantized.
     quantize_weights: bool = False
     quantize_kv: bool = False
+
+    def __post_init__(self) -> None:
+        if self.repetition_penalty <= 0:
+            raise ValueError(
+                f"repetition_penalty must be > 0 (1.0 disables), got "
+                f"{self.repetition_penalty} — this is NOT the "
+                "top_k-style 0-disables convention")
+        if not 0 <= self.min_new_tokens <= self.max_new_tokens:
+            raise ValueError(
+                f"min_new_tokens={self.min_new_tokens} outside "
+                f"[0, max_new_tokens={self.max_new_tokens}]")
 
 
 @dataclass

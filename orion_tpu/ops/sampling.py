@@ -1,14 +1,25 @@
 """Token sampling: temperature / top-k / top-p, with logprob capture.
 
 Returns the logprob of the sampled token under the *actual* sampling
-distribution (post temperature + truncation) — this is the behavioral
-policy used for importance ratios in the off-policy/async path; trainers
-additionally recompute logprobs under the training graph (SURVEY.md §4
-"logprob parity").  Logprobs are computed in f32 (bf16 softmax drift is
-hard-part #4 in SURVEY.md §7).
+distribution (post temperature + truncation + penalties) — this is the
+behavioral policy used for importance ratios in the off-policy/async
+path; trainers additionally recompute logprobs under the training graph
+(SURVEY.md §4 "logprob parity").  Logprobs are computed in f32 (bf16
+softmax drift is hard-part #4 in SURVEY.md §7).
+
+Generation controls (the vLLM-equivalent sampling-params surface):
+``repetition_penalty`` (HF/vLLM convention: seen tokens' positive
+logits divided by the penalty, negative multiplied) with the seen-set
+supplied by the engine as a [B, V] mask, and ``forbid`` (a [B, V] mask
+of tokens barred from this step — how engines implement
+``min_new_tokens`` by suppressing EOS).  Both transform the SAMPLING
+distribution only: ``policy_logprobs`` stays the raw untempered policy,
+so the off-policy importance ratio remains correct under any controls.
 """
 
 from __future__ import annotations
+
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -36,33 +47,85 @@ def _mask_top_p(logits: jnp.ndarray, top_p: float) -> jnp.ndarray:
     return jnp.where(logits < threshold, _NEG_INF, logits)
 
 
+def apply_repetition_penalty(logits: jnp.ndarray, seen: jnp.ndarray,
+                             penalty: float) -> jnp.ndarray:
+    """HF/vLLM repetition penalty: for tokens in the seen set, positive
+    logits are divided by ``penalty`` and negative ones multiplied —
+    both push the token down for penalty > 1."""
+    penalized = jnp.where(logits > 0, logits / penalty, logits * penalty)
+    return jnp.where(seen, penalized, logits)
+
+
+def seen_from_prompts(prompt_ids: jnp.ndarray, prompt_lens: jnp.ndarray,
+                      vocab_size: int) -> jnp.ndarray:
+    """[B, V] bool seen-set from right-padded prompts (HF/vLLM: the
+    repetition penalty covers prompt tokens too).  Pad positions index
+    vocab_size and drop."""
+    B, P = prompt_ids.shape
+    positions = jnp.broadcast_to(jnp.arange(P, dtype=jnp.int32), (B, P))
+    safe = jnp.where(positions < prompt_lens[:, None], prompt_ids,
+                     vocab_size)
+    return jnp.zeros((B, vocab_size), bool).at[
+        jnp.arange(B)[:, None], safe].set(True, mode="drop")
+
+
+def eos_forbid_mask(batch: int, vocab_size: int, eos_id: int,
+                    under_min) -> jnp.ndarray:
+    """[B, V] bool mask suppressing EOS for sequences still under
+    min_new_tokens (``under_min``: scalar or [B] bool)."""
+    return jnp.zeros((batch, vocab_size), bool).at[:, eos_id].set(
+        under_min)
+
+
 def sample_tokens(rng: jax.Array, logits: jnp.ndarray, temperature: float,
-                  top_k: int = 0, top_p: float = 1.0) -> tuple:
+                  top_k: int = 0, top_p: float = 1.0,
+                  seen: Optional[jnp.ndarray] = None,
+                  repetition_penalty: float = 1.0,
+                  forbid: Optional[jnp.ndarray] = None) -> tuple:
     """Sample next tokens from [B, V] logits.
 
     Returns (tokens [B] int32, sample_logprobs [B] f32,
     policy_logprobs [B] f32).  ``sample_logprobs`` is the logprob under
-    the *actual* sampling distribution (post temperature + truncation);
+    the *actual* sampling distribution (post temperature, truncation,
+    repetition penalty, and forbidden-token suppression);
     ``policy_logprobs`` is under the raw untempered policy — the
     behavior-policy logprob the async off-policy importance ratio needs
-    (SURVEY.md §3b).  temperature == 0.0 means greedy.
+    (SURVEY.md §3b).  temperature == 0.0 means greedy (over the
+    transformed distribution, so controls still bind).
+
+    seen: [B, V] bool — tokens already in the sequence, penalized by
+      ``repetition_penalty`` when != 1.0.
+    forbid: [B, V] bool — tokens suppressed this step (−inf).
     """
     logits = logits.astype(jnp.float32)
     raw_logps = jax.nn.log_softmax(logits, axis=-1)
+    transformed = repetition_penalty != 1.0 or forbid is not None
+    if seen is not None and repetition_penalty != 1.0:
+        logits = apply_repetition_penalty(logits, seen,
+                                          repetition_penalty)
+    if forbid is not None:
+        logits = jnp.where(forbid, _NEG_INF, logits)
 
     def take(logps, tokens):
         return jnp.take_along_axis(logps, tokens[:, None], axis=-1)[:, 0]
 
     if temperature == 0.0:
         tokens = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        lp = take(raw_logps, tokens)
-        return tokens, lp, lp
+        plp = take(raw_logps, tokens)
+        # Greedy over a TRANSFORMED distribution is a delta: the honest
+        # behavior logprob is log 1 = 0 (raw lp could be tiny for a
+        # penalty-displaced argmax, which would bias importance
+        # ratios).  Untransformed greedy keeps the raw lp — the
+        # engines' historical (and diagnostically useful) convention.
+        lp = jnp.zeros_like(plp) if transformed else plp
+        return tokens, lp, plp
     logits = logits / temperature
     if top_k > 0:
         logits = _mask_top_k(logits, top_k)
     if top_p < 1.0:
         logits = _mask_top_p(logits, top_p)
-    if temperature == 1.0 and top_k <= 0 and top_p >= 1.0:
+    if temperature == 1.0 and top_k <= 0 and top_p >= 1.0 and \
+            not transformed:
         logps = raw_logps  # sampling dist == policy dist: one softmax
     else:
         logps = jax.nn.log_softmax(logits, axis=-1)

@@ -173,6 +173,10 @@ class ContinuousBatchingEngine:
             "lps": jnp.zeros((S, T), jnp.float32),
             "plps": jnp.zeros((S, T), jnp.float32),
         }
+        if self.cfg.repetition_penalty != 1.0:
+            # per-slot seen-token set (prompt + generated), reset at
+            # admission — the repetition-penalty state.
+            state["seen"] = jnp.zeros((S, self.mc.vocab_size), bool)
         if self.mesh is not None:  # replicated across the rollout group
             state = jax.device_put(
                 state, NamedSharding(self.mesh, P()))
@@ -264,12 +268,31 @@ class ContinuousBatchingEngine:
             {"params": params}, prompt_ids, positions, cache,
             logits_positions=(prompt_lens - 1)[:, None])
         last = logits[:, 0]
+        V = last.shape[-1]
+        pen = self.cfg.repetition_penalty != 1.0
+        min_new = self.cfg.min_new_tokens if self.eos is not None else 0
+        from orion_tpu.ops.sampling import (eos_forbid_mask,
+                                            seen_from_prompts)
+
+        kw = {}
+        if pen:
+            # wave-level seen set from the admitted prompts
+            wave_seen = seen_from_prompts(prompt_ids, prompt_lens, V)
+            kw = {"seen": wave_seen,
+                  "repetition_penalty": self.cfg.repetition_penalty}
+        if min_new > 0:
+            # generated count is 0 at admission: EOS always suppressed
+            kw["forbid"] = eos_forbid_mask(B, V, self.eos, True)
         tok0, lp0, plp0 = sample_tokens(
             rng, last, temperature=self.cfg.temperature,
-            top_k=self.cfg.top_k, top_p=self.cfg.top_p)
+            top_k=self.cfg.top_k, top_p=self.cfg.top_p, **kw)
         d0 = (tok0 == self.eos) if self.eos is not None else \
             jnp.zeros((B,), bool)
         st = dict(state)
+        if pen:
+            wave_seen = wave_seen.at[jnp.arange(B), tok0].set(True)
+            st["seen"] = st["seen"].at[slot_idx].set(wave_seen,
+                                                     mode="drop")
         st["cur_tok"] = st["cur_tok"].at[slot_idx].set(tok0, mode="drop")
         st["lengths"] = st["lengths"].at[slot_idx].set(prompt_lens,
                                                        mode="drop")
@@ -309,9 +332,22 @@ class ContinuousBatchingEngine:
                 {"params": params}, st["cur_tok"][:, None], positions,
                 cache)
             rng, sub = jax.random.split(rng)
+            V = logits.shape[-1]
+            pen = self.cfg.repetition_penalty != 1.0
+            min_new = (self.cfg.min_new_tokens
+                       if self.eos is not None else 0)
+            kw = {}
+            if pen:
+                kw = {"seen": st["seen"],
+                      "repetition_penalty": self.cfg.repetition_penalty}
+            if min_new > 0:
+                from orion_tpu.ops.sampling import eos_forbid_mask
+
+                kw["forbid"] = eos_forbid_mask(S, V, self.eos,
+                                               st["n_new"] < min_new)
             nxt, lp, plp = sample_tokens(
                 sub, logits[:, 0], temperature=self.cfg.temperature,
-                top_k=self.cfg.top_k, top_p=self.cfg.top_p)
+                top_k=self.cfg.top_k, top_p=self.cfg.top_p, **kw)
             live = ~st["done"]
             nxt = jnp.where(live, nxt, pad)
             lp = jnp.where(live, lp, 0.0)
@@ -319,6 +355,9 @@ class ContinuousBatchingEngine:
             # dead slots write at T (out of bounds) -> scatter drops.
             wi = jnp.where(live, st["n_new"], T)
             st = dict(st)
+            if pen:
+                st["seen"] = st["seen"].at[
+                    s_idx, jnp.where(live, nxt, V)].set(True, mode="drop")
             st["toks"] = st["toks"].at[s_idx, wi].set(nxt, mode="drop")
             st["lps"] = st["lps"].at[s_idx, wi].set(lp, mode="drop")
             st["plps"] = st["plps"].at[s_idx, wi].set(plp, mode="drop")

@@ -157,8 +157,34 @@ class RolloutEngine:
                 {"params": params}, prompt_ids, positions, cache,
                 logits_positions=(prompt_lens - 1)[:, None])
         last = logits[:, 0]
+        V = last.shape[-1]
+        # Generation controls (static per compile): repetition penalty
+        # carries a [B, V] seen-set (prompt tokens included, HF/vLLM
+        # convention); min_new_tokens suppresses EOS until each
+        # sequence has generated that many tokens.
+        from orion_tpu.ops.sampling import (eos_forbid_mask,
+                                            seen_from_prompts)
+
+        pen = cfg.repetition_penalty != 1.0
+        min_new = cfg.min_new_tokens if eos is not None else 0
+        bidx = jnp.arange(B)
+        seen = seen_from_prompts(prompt_ids, prompt_lens, V) if pen \
+            else jnp.zeros((B, 1), bool)  # carried but unused when off
+
+        def ctrl_kwargs(seen, n_generated):
+            kw = {}
+            if pen:
+                kw["seen"] = seen
+                kw["repetition_penalty"] = cfg.repetition_penalty
+            if min_new > 0:
+                kw["forbid"] = eos_forbid_mask(B, V, eos,
+                                               n_generated < min_new)
+            return kw
+
         rng, sub = jax.random.split(rng)
-        tok0, lp0, plp0 = sample(sub, last)
+        tok0, lp0, plp0 = sample(sub, last, **ctrl_kwargs(seen, 0))
+        if pen:
+            seen = seen.at[bidx, tok0].set(True)
 
         tokens = jnp.full((B, T), pad, jnp.int32).at[:, 0].set(tok0)
         logps = jnp.zeros((B, T), jnp.float32).at[:, 0].set(lp0)
@@ -172,15 +198,19 @@ class RolloutEngine:
 
         def body(c):
             t, cur_tok, cur_pos, rng, done, tokens, logps, plogps, state = c
-            cache, comp_len = state
+            cache, comp_len, seen = state
             step_logits, cache = self._decode_model.apply(
                 {"params": params}, cur_tok[:, None], cur_pos[:, None],
                 cache)
             rng, sub = jax.random.split(rng)
-            nxt, lp, plp = sample(sub, step_logits[:, 0])
+            nxt, lp, plp = sample(sub, step_logits[:, 0],
+                                  **ctrl_kwargs(seen, t))
             nxt = jnp.where(done, pad, nxt)
             lp = jnp.where(done, 0.0, lp)
             plp = jnp.where(done, 0.0, plp)
+            if pen:
+                seen = seen.at[bidx, jnp.where(done, V, nxt)].set(
+                    True, mode="drop")
             tokens = tokens.at[:, t].set(nxt, mode="drop")
             logps = logps.at[:, t].set(lp, mode="drop")
             plogps = plogps.at[:, t].set(plp, mode="drop")
@@ -188,12 +218,13 @@ class RolloutEngine:
             if eos is not None:
                 done = done | (nxt == eos)
             return (t + 1, nxt, cur_pos + 1, rng, done, tokens, logps,
-                    plogps, (cache, comp_len))
+                    plogps, (cache, comp_len, seen))
 
         init = (jnp.int32(1), tok0, prompt_lens, rng, done, tokens, logps,
-                plogps, (cache, comp_len))
+                plogps, (cache, comp_len, seen))
         with jax.named_scope("decode"):
-            _, _, _, _, done, tokens, logps, plogps, (cache, comp_len) = \
+            _, _, _, _, done, tokens, logps, plogps, \
+                (cache, comp_len, seen) = \
                 jax.lax.while_loop(cond, body, init)
 
         mask = (jnp.arange(T)[None, :] < comp_len[:, None]).astype(jnp.float32)

@@ -1,0 +1,160 @@
+"""Generation controls (the vLLM sampling-params surface, SURVEY.md §2
+#5): min_new_tokens (EOS suppression) and repetition_penalty (HF/vLLM
+seen-token downweighting) across ops.sampling and BOTH engines."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from orion_tpu.config import ModelConfig, RolloutConfig
+from orion_tpu.models import Transformer, init_params
+from orion_tpu.ops.sampling import apply_repetition_penalty, sample_tokens
+from orion_tpu.rollout import RolloutEngine
+from orion_tpu.rollout.continuous import ContinuousBatchingEngine
+
+
+# -- ops level --------------------------------------------------------------
+
+
+def test_repetition_penalty_downweights_seen():
+    logits = jnp.asarray([[2.0, -1.0, 0.5, 1.0]])
+    seen = jnp.asarray([[True, True, False, False]])
+    out = apply_repetition_penalty(logits, seen, 2.0)
+    np.testing.assert_allclose(
+        np.asarray(out), [[1.0, -2.0, 0.5, 1.0]])  # pos/=p, neg*=p
+
+
+def test_forbid_excludes_token_and_keeps_policy_logprobs():
+    rng = jax.random.key(0)
+    logits = jnp.asarray(np.random.RandomState(0).randn(4, 16), jnp.float32)
+    forbid = jnp.zeros((4, 16), bool).at[:, 3].set(True)
+    toks, lp, plp = sample_tokens(rng, logits, temperature=1.0,
+                                  forbid=forbid)
+    assert (np.asarray(toks) != 3).all()
+    # policy logprobs are the RAW policy's, untouched by controls
+    raw = jax.nn.log_softmax(logits, axis=-1)
+    np.testing.assert_allclose(
+        np.asarray(plp),
+        np.asarray(jnp.take_along_axis(raw, toks[:, None], 1)[:, 0]),
+        rtol=1e-6)
+
+
+def test_greedy_respects_controls():
+    logits = jnp.asarray([[5.0, 4.0, 1.0]])
+    forbid = jnp.asarray([[True, False, False]])
+    toks, _, _ = sample_tokens(jax.random.key(0), logits, temperature=0.0,
+                               forbid=forbid)
+    assert int(toks[0]) == 1  # argmax moved off the forbidden token
+    seen = jnp.asarray([[True, False, False]])
+    toks, _, _ = sample_tokens(jax.random.key(0), logits, temperature=0.0,
+                               seen=seen, repetition_penalty=10.0)
+    assert int(toks[0]) == 1
+
+
+# -- engine level -----------------------------------------------------------
+
+
+def _gen(engine_kind, eos, **rkw):
+    cfg = ModelConfig.tiny(dtype="float32")
+    model = Transformer(cfg)
+    params = init_params(model, jax.random.key(0), cfg)
+    rs = np.random.RandomState(0)
+    ids = rs.randint(1, cfg.vocab_size, (4, 10)).astype(np.int32)
+    lens = np.full((4,), 10, np.int32)
+    if engine_kind == "simple":
+        eng = RolloutEngine(
+            model, cfg, RolloutConfig(max_new_tokens=12, temperature=0.0,
+                                      **rkw), eos_token_id=eos)
+        eng.load_weights(params)
+        return cfg, ids, eng.generate(jnp.asarray(ids), jnp.asarray(lens),
+                                      jax.random.key(1))
+    eng = ContinuousBatchingEngine(
+        model, cfg,
+        RolloutConfig(max_prompt_len=12, max_new_tokens=12,
+                      temperature=0.0, page_size=4, max_batch_size=2,
+                      **rkw), eos_token_id=eos, segment_len=4)
+    return cfg, ids, eng.generate_batch(ids, lens, jax.random.key(1),
+                                        params=params)
+
+
+def _eos_for(greedy_result):
+    """Pick an EOS id the greedy decode actually emits early, so the
+    min_new suppression has something to bite on."""
+    toks = np.asarray(greedy_result.completions)
+    return int(toks[0, 1])
+
+
+def test_simple_engine_min_new_tokens():
+    _, _, base = _gen("simple", eos=None)
+    eos = _eos_for(base)
+    _, _, r0 = _gen("simple", eos=eos)
+    _, _, r1 = _gen("simple", eos=eos, min_new_tokens=8)
+    # without the control at least one sequence stops early...
+    assert (np.asarray(r0.completion_lens) < 8).any(), \
+        "test premise broken: nothing stops early"
+    # ...with it, every sequence generates >= 8 tokens
+    assert (np.asarray(r1.completion_lens) >= 8).all(), \
+        np.asarray(r1.completion_lens)
+
+
+def test_continuous_engine_min_new_tokens():
+    _, _, base = _gen("continuous", eos=None)
+    eos = _eos_for(base)
+    _, _, r0 = _gen("continuous", eos=eos)
+    _, _, r1 = _gen("continuous", eos=eos, min_new_tokens=8)
+    assert (np.asarray(r0.completion_lens) < 8).any(), \
+        "test premise broken: nothing stops early"
+    assert (np.asarray(r1.completion_lens) >= 8).all(), \
+        np.asarray(r1.completion_lens)
+
+
+def test_simple_engine_repetition_penalty():
+    cfg, prompt, r = _gen("simple", eos=None, repetition_penalty=1e9)
+    toks = np.asarray(r.completions)
+    for b in range(toks.shape[0]):
+        row = toks[b]
+        # no token repeats, and none comes from the prompt (the seen
+        # set starts from the prompt tokens, HF/vLLM convention)
+        assert len(np.unique(row)) == len(row), row
+        assert not np.isin(row, prompt[b]).any(), (row, prompt[b])
+
+
+def test_continuous_engine_repetition_penalty():
+    cfg, prompt, r = _gen("continuous", eos=None, repetition_penalty=1e9)
+    toks = np.asarray(r.completions)
+    for b in range(toks.shape[0]):
+        row = toks[b]
+        assert len(np.unique(row)) == len(row), row
+        assert not np.isin(row, prompt[b]).any(), (row, prompt[b])
+
+
+def test_penalty_engines_agree():
+    """Same controls → same greedy output from both engines."""
+    _, _, a = _gen("simple", eos=None, repetition_penalty=1.3)
+    _, _, b = _gen("continuous", eos=None, repetition_penalty=1.3)
+    np.testing.assert_array_equal(np.asarray(a.completions),
+                                  np.asarray(b.completions))
+
+
+def test_config_validates_controls():
+    import pytest
+
+    with pytest.raises(ValueError, match="repetition_penalty"):
+        RolloutConfig(repetition_penalty=0.0)
+    with pytest.raises(ValueError, match="min_new_tokens"):
+        RolloutConfig(max_new_tokens=8, min_new_tokens=9)
+
+
+def test_greedy_behavior_logprob_is_delta_under_controls():
+    """Transformed greedy is a deterministic policy: behavior logprob 0
+    (raw lp of a penalty-displaced argmax would bias importance
+    ratios); policy_logprobs stay raw."""
+    logits = jnp.asarray([[5.0, -4.0, 1.0]])
+    seen = jnp.asarray([[True, False, False]])
+    toks, lp, plp = sample_tokens(jax.random.key(0), logits,
+                                  temperature=0.0, seen=seen,
+                                  repetition_penalty=100.0)
+    assert int(toks[0]) == 2
+    assert float(lp[0]) == 0.0
+    raw = jax.nn.log_softmax(logits, axis=-1)
+    np.testing.assert_allclose(float(plp[0]), float(raw[0, 2]), rtol=1e-6)
