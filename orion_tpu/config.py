@@ -277,6 +277,8 @@ class DataConfig:
     # Directory of <dataset>.jsonl files in the upstream HF schema —
     # the offline path for real datasets on a zero-egress box.
     data_dir: Optional[str] = None
+    # Split used for the held-out eval loop (TrainConfig.eval_every).
+    eval_split: str = "test"
 
 
 @dataclass
@@ -299,6 +301,12 @@ class TrainConfig:
     reward: str = "math"
 
     total_iterations: int = 100
+    # Held-out evaluation: every N iterations, generate on eval_batches
+    # batches from the eval iterator (launch.py builds it from
+    # data.eval_split) and log eval_reward_mean / eval lengths — no
+    # parameter update.  0 disables.
+    eval_every: int = 0
+    eval_batches: int = 1
     # Experience batch: prompts per iteration; optimization runs
     # num_epochs passes of minibatches of size minibatch_size over it.
     rollout_batch_size: int = 32

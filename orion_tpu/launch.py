@@ -155,8 +155,30 @@ def main(argv: Optional[list] = None) -> Any:
         system_prompt=cfg.data.system_prompt,
         synthetic_size=cfg.data.synthetic_size,
         data_dir=cfg.data.data_dir)
+    eval_iter = None
+    if cfg.eval_every:
+        # Held-out split (synthetic: a disjoint seed stream).
+        eval_iter = build_prompt_iterator(
+            cfg.data.dataset, tokenizer, cfg.rollout_batch_size,
+            cfg.rollout.max_prompt_len,
+            split=(cfg.data.split if cfg.data.dataset == "synthetic"
+                   else cfg.data.eval_split),
+            seed=cfg.seed + 1000003,
+            use_chat_template=cfg.data.use_chat_template,
+            system_prompt=cfg.data.system_prompt,
+            synthetic_size=cfg.data.synthetic_size,
+            data_dir=cfg.data.data_dir)
 
     if cfg.async_mode:
+        if cfg.eval_every:
+            # The rollout group's engine is driven by the rollout
+            # thread; a learner-side eval would either contend for the
+            # train mesh or race that engine.  Fail loudly rather than
+            # silently dropping the user's eval config.
+            raise ValueError(
+                "eval_every is not supported with async_mode yet: run "
+                "periodic evals offline from the saved checkpoints, or "
+                "set eval_every=0")
         from orion_tpu.orchestration import AsyncOrchestrator, split_devices
 
         n_roll = cfg.rollout_devices or max(1, len(jax.devices()) // 2)
@@ -171,8 +193,8 @@ def main(argv: Optional[list] = None) -> Any:
     mesh = make_mesh(cfg.mesh)
     with mesh:
         trainer = build_trainer(algo, cfg, mesh, tokenizer)
-        trainer.resume(prompt_iter)
-        return trainer.train(prompt_iter)
+        trainer.resume(prompt_iter, eval_iter=eval_iter)
+        return trainer.train(prompt_iter, eval_iter=eval_iter)
 
 
 if __name__ == "__main__":

@@ -295,7 +295,9 @@ class BaseTrainer:
         self._rng, sub = jax.random.split(self._rng)
         return sub
 
-    def generate(self, prompt_ids, prompt_lens) -> GenerationResult:
+    def generate(self, prompt_ids, prompt_lens,
+                 rng: Optional[jax.Array] = None) -> GenerationResult:
+        rng = self.next_rng() if rng is None else rng
         if hasattr(self.engine, "generate_batch"):
             # Continuous engine: host-driven admission loop; it takes
             # host prompt arrays directly.  params=None -> the engine
@@ -303,11 +305,11 @@ class BaseTrainer:
             # construction (an explicit tree here would be re-cast every
             # iteration for nothing).
             return self.engine.generate_batch(
-                prompt_ids, prompt_lens, self.next_rng())
+                prompt_ids, prompt_lens, rng)
         # One batched host→device transfer for both prompt arrays.
         ids, lens = jax.device_put((np.asarray(prompt_ids),
                                     np.asarray(prompt_lens)))
-        return self.engine.generate(ids, lens, self.next_rng(),
+        return self.engine.generate(ids, lens, rng,
                                     params=self.state.params)
 
     def score(self, result: GenerationResult, batch: dict) -> np.ndarray:
@@ -437,9 +439,67 @@ class BaseTrainer:
         self.engine.load_weights(self.state.params)
 
     # ------------------------------------------------------------------
+    # held-out evaluation (TrainConfig.eval_every)
+    # ------------------------------------------------------------------
+    def evaluate(self, eval_iter: Iterator[dict],
+                 n_batches: Optional[int] = None) -> dict:
+        """Generate + score on held-out prompts; NO parameter update.
+
+        Uses a dedicated RNG stream (seed ⊕ global_iter) so running (or
+        skipping) evaluation never perturbs the training trajectory —
+        ``next_rng`` is untouched.  Returns eval_-prefixed scalar stats.
+        """
+        n_batches = (self.cfg.eval_batches if n_batches is None
+                     else n_batches)
+        if n_batches < 1:
+            raise ValueError(
+                f"eval needs >= 1 batch, got eval_batches={n_batches} "
+                "(disable evaluation with eval_every=0, not "
+                "eval_batches=0)")
+        rng = jax.random.fold_in(
+            jax.random.key(self.cfg.seed + 424242), self.global_iter)
+        rewards, lens = [], []
+        for i in range(n_batches):
+            batch = next(eval_iter)
+            ids, plens, meta = self.prepare_prompts(batch)
+            rng, sub = jax.random.split(rng)
+            result = self.generate(ids, plens, rng=sub)
+            host = result.to_host()
+            wants_device = getattr(self.reward_fn,
+                                   "wants_device_result", False)
+            scores = self.score(result if wants_device else host, meta)
+            rewards.append(np.asarray(scores, np.float32))
+            lens.append(np.asarray(host.completion_lens, np.float32))
+        rewards = np.concatenate(rewards)
+        lens = np.concatenate(lens)
+        return {
+            "eval_reward_mean": float(rewards.mean()),
+            "eval_reward_std": float(rewards.std()),
+            "eval_completion_len_mean": float(lens.mean()),
+            "eval_n_samples": int(rewards.shape[0]),
+        }
+
+    def _maybe_evaluate(self, eval_iter) -> None:
+        """train()-loop hook: run + log held-out eval on schedule."""
+        if (eval_iter is None or not self.cfg.eval_every or
+                self.global_iter % self.cfg.eval_every != 0):
+            return
+        stats = self.evaluate(eval_iter)
+        stats["iteration"] = self.global_iter
+        self.metrics_history.append(stats)
+        if self.writer is not None:
+            self.writer.write(self.global_iter, stats)
+        if self.cfg.log_every:
+            print(f"[orion-tpu] eval@{self.global_iter} "
+                  f"reward={stats['eval_reward_mean']:.4g} "
+                  f"len={stats['eval_completion_len_mean']:.1f}",
+                  flush=True)
+
+    # ------------------------------------------------------------------
     # checkpoint/resume (SURVEY.md §2 #17)
     # ------------------------------------------------------------------
-    def _extra_state(self, prompt_iter=None, data_state=None) -> dict:
+    def _extra_state(self, prompt_iter=None, data_state=None,
+                     eval_iter=None) -> dict:
         extra = {
             "global_iter": self.global_iter,
             "rng": np.asarray(jax.random.key_data(self._rng)).tolist(),
@@ -454,16 +514,20 @@ class BaseTrainer:
             extra["data"] = data_state
         elif prompt_iter is not None and hasattr(prompt_iter, "state"):
             extra["data"] = prompt_iter.state()
+        if eval_iter is not None and hasattr(eval_iter, "state"):
+            extra["eval_data"] = eval_iter.state()
         return extra
 
-    def save_checkpoint(self, prompt_iter=None, data_state=None) -> None:
+    def save_checkpoint(self, prompt_iter=None, data_state=None,
+                        eval_iter=None) -> None:
         if self.ckpt is None:
             raise ValueError("configure checkpoint_dir + checkpoint_every")
         self.ckpt.save(self.global_iter, self.state,
                        critic_state=getattr(self, "critic_state", None),
-                       extra=self._extra_state(prompt_iter, data_state))
+                       extra=self._extra_state(prompt_iter, data_state,
+                                               eval_iter))
 
-    def resume(self, prompt_iter=None) -> bool:
+    def resume(self, prompt_iter=None, eval_iter=None) -> bool:
         """Restore the latest checkpoint if one exists.  Returns True if
         training state was restored."""
         if self.ckpt is None or self.ckpt.latest_step() is None:
@@ -486,18 +550,24 @@ class BaseTrainer:
         if "data" in extra and prompt_iter is not None and \
                 hasattr(prompt_iter, "load_state"):
             prompt_iter.load_state(extra["data"])
+        if "eval_data" in extra and eval_iter is not None and \
+                hasattr(eval_iter, "load_state"):
+            eval_iter.load_state(extra["eval_data"])
         self.sync_weights()
         return True
 
     # ------------------------------------------------------------------
     def train(self, prompt_iter: Iterator[dict],
-              num_iterations: Optional[int] = None) -> list:
+              num_iterations: Optional[int] = None,
+              eval_iter: Optional[Iterator[dict]] = None) -> list:
         """The outer loop (SURVEY.md §3a).
 
         ``num_iterations`` means "run this many more"; without it the
         horizon is ``cfg.total_iterations`` *total*, counted by
         ``global_iter`` — so a resumed run executes only the remaining
         iterations and LR schedules stay on their decay horizon.
+        ``eval_iter``: held-out prompt stream for the cfg.eval_every
+        evaluation loop (launch.py builds it from data.eval_split).
         """
         import time
 
@@ -555,7 +625,10 @@ class BaseTrainer:
                     self._finalize_iteration(pending, fetched,
                                              now=time.perf_counter())
                     pending = None
-                    self.save_checkpoint(prompt_iter)
+                    self.save_checkpoint(prompt_iter, eval_iter=eval_iter)
+                # Held-out eval on schedule (generates with the
+                # freshest weights — sync_weights already ran).
+                self._maybe_evaluate(eval_iter)
             if pending is not None:  # flush the last iteration's stats
                 fetched = jax.device_get(pending["dev"])
                 self._finalize_iteration(pending, fetched,

@@ -1,0 +1,82 @@
+"""Held-out evaluation loop (TrainConfig.eval_every): scheduled eval
+during training, trajectory-neutral, wired through the launcher."""
+
+import json
+
+import jax
+import numpy as np
+import pytest
+
+from orion_tpu.config import GRPOConfig, OptimizerConfig, RolloutConfig
+from orion_tpu.models import Transformer, init_params
+from orion_tpu.trainers import GRPOTrainer
+
+from test_trainers import (lucky_token_reward, prompt_stream,
+                           tiny_model_cfg, _mk)
+
+
+def _trainer(**kw):
+    cfg = _mk(GRPOConfig, group_size=2, kl_coef=0.0, num_epochs=1,
+              minibatch_size=4, **kw)
+    model = Transformer(cfg.model)
+    params = init_params(model, jax.random.key(0), cfg.model)
+    return cfg, GRPOTrainer(cfg, model, params,
+                            reward_fn=lucky_token_reward,
+                            eos_token_id=None)
+
+
+def test_evaluate_returns_stats_and_keeps_state():
+    cfg, tr = _trainer()
+    before = np.asarray(jax.tree.leaves(tr.state.params)[0]).copy()
+    rng_before = np.asarray(jax.random.key_data(tr._rng)).copy()
+    stats = tr.evaluate(prompt_stream(4, 5, seed=9), n_batches=2)
+    assert set(stats) >= {"eval_reward_mean", "eval_reward_std",
+                          "eval_completion_len_mean", "eval_n_samples"}
+    assert stats["eval_n_samples"] == 4 * 2 * 2  # batches * prompts * group
+    assert 0.0 <= stats["eval_reward_mean"] <= 1.0
+    # no parameter update, and the TRAINING rng stream is untouched
+    np.testing.assert_array_equal(
+        np.asarray(jax.tree.leaves(tr.state.params)[0]), before)
+    np.testing.assert_array_equal(
+        np.asarray(jax.random.key_data(tr._rng)), rng_before)
+
+
+def test_eval_every_schedules_during_train():
+    cfg, tr = _trainer(eval_every=2)
+    hist = tr.train(prompt_stream(8, 5), num_iterations=4,
+                    eval_iter=prompt_stream(4, 5, seed=9))
+    evals = [h for h in hist if "eval_reward_mean" in h]
+    # global_iter hits 2 and 4 → two evals
+    assert len(evals) == 2, [sorted(h) for h in hist]
+    assert {e["iteration"] for e in evals} == {2, 4}
+
+
+def test_eval_does_not_change_training_trajectory():
+    """Same seeds, with and without eval: identical training params."""
+    _, tr_a = _trainer(eval_every=1)
+    _, tr_b = _trainer()
+    tr_a.train(prompt_stream(8, 5), num_iterations=3,
+               eval_iter=prompt_stream(4, 5, seed=9))
+    tr_b.train(prompt_stream(8, 5), num_iterations=3)
+    for a, b in zip(jax.tree.leaves(tr_a.state.params),
+                    jax.tree.leaves(tr_b.state.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_launch_eval_every(tmp_path):
+    from orion_tpu.launch import main
+
+    main([
+        "grpo",
+        "model.vocab_size=260", "model.hidden_size=32",
+        "model.intermediate_size=64", "model.num_layers=2",
+        "model.num_heads=4", "model.num_kv_heads=2", "model.dtype=float32",
+        "rollout.max_new_tokens=8", "rollout.max_prompt_len=32",
+        "rollout_batch_size=2", "minibatch_size=4", "group_size=2",
+        "total_iterations=2", "eval_every=2", "eval_batches=1",
+        "optimizer.learning_rate=1e-4",
+        f"log_dir={tmp_path}/logs", "log_every=0",
+    ])
+    lines = [json.loads(line) for line in
+             open(tmp_path / "logs" / "metrics.jsonl")]
+    assert any("eval_reward_mean" in row for row in lines), lines
