@@ -157,6 +157,12 @@ def main(argv: Optional[list] = None) -> Any:
         data_dir=cfg.data.data_dir)
     eval_iter = None
     if cfg.eval_every:
+        if cfg.eval_batches < 1:
+            # Catch it HERE, not hours in at the first scheduled eval.
+            raise ValueError(
+                f"eval_every={cfg.eval_every} needs eval_batches >= 1 "
+                f"(got {cfg.eval_batches}); disable eval with "
+                "eval_every=0")
         # Held-out split (synthetic: a disjoint seed stream).
         eval_iter = build_prompt_iterator(
             cfg.data.dataset, tokenizer, cfg.rollout_batch_size,
@@ -170,15 +176,6 @@ def main(argv: Optional[list] = None) -> Any:
             data_dir=cfg.data.data_dir)
 
     if cfg.async_mode:
-        if cfg.eval_every:
-            # The rollout group's engine is driven by the rollout
-            # thread; a learner-side eval would either contend for the
-            # train mesh or race that engine.  Fail loudly rather than
-            # silently dropping the user's eval config.
-            raise ValueError(
-                "eval_every is not supported with async_mode yet: run "
-                "periodic evals offline from the saved checkpoints, or "
-                "set eval_every=0")
         from orion_tpu.orchestration import AsyncOrchestrator, split_devices
 
         n_roll = cfg.rollout_devices or max(1, len(jax.devices()) // 2)
@@ -186,9 +183,9 @@ def main(argv: Optional[list] = None) -> Any:
         mesh = make_mesh(cfg.mesh, devices=train_devs)
         with mesh:
             trainer = build_trainer(algo, cfg, mesh, tokenizer)
-            trainer.resume(prompt_iter)
+            trainer.resume(prompt_iter, eval_iter=eval_iter)
             orch = AsyncOrchestrator(trainer, rollout_devs)
-            return orch.train(prompt_iter)
+            return orch.train(prompt_iter, eval_iter=eval_iter)
 
     mesh = make_mesh(cfg.mesh)
     with mesh:

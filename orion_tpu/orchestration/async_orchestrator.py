@@ -217,10 +217,7 @@ class AsyncOrchestrator:
                 # as numpy (ONE batched fetch); the learner's jitted
                 # programs re-place it on the train mesh.
                 host = result.to_host()
-                wants_device = getattr(self.trainer.reward_fn,
-                                       "wants_device_result", False)
-                scores = self.trainer.score(
-                    result if wants_device else host, meta)
+                scores = self.trainer._score_result(result, host, meta)
                 item = _Item(host._fields(), scores, version, data_state)
                 while not self._stop.is_set():
                     try:
@@ -234,8 +231,15 @@ class AsyncOrchestrator:
 
     # ------------------------------------------------------------------
     def train(self, prompt_iter: Iterator[dict],
-              num_iterations: Optional[int] = None) -> list:
-        """The decoupled loop (SURVEY.md §3b).  Returns metrics history."""
+              num_iterations: Optional[int] = None,
+              eval_iter: Optional[Iterator[dict]] = None) -> list:
+        """The decoupled loop (SURVEY.md §3b).  Returns metrics history.
+
+        ``eval_iter``: held-out prompts for cfg.eval_every evaluation.
+        Eval generates on the LEARNER's own engine (train mesh) — the
+        rollout group's engine belongs to the rollout thread and must
+        not be raced — so the learner stalls for the eval's duration on
+        eval iterations only."""
         from orion_tpu.rollout import GenerationResult
         from orion_tpu.trainers.base import _ProfileWindow
 
@@ -285,6 +289,14 @@ class AsyncOrchestrator:
                 with self._version_cv:
                     self._version += 1
                     self._version_cv.notify_all()
+                if (eval_iter is not None and trainer.cfg.eval_every and
+                        trainer.global_iter %
+                        trainer.cfg.eval_every == 0):
+                    # refresh the trainer-side engine first: in async
+                    # mode nothing else calls sync_weights, and the
+                    # update step donates the old param buffers.
+                    trainer.sync_weights()
+                    trainer._maybe_evaluate(eval_iter)
                 t2 = time.perf_counter()
                 stats.update(exp_stats)
                 n_samples = int(item.result_host["prompt_lens"].shape[0])
@@ -306,7 +318,8 @@ class AsyncOrchestrator:
                     # for the batch being trained — it lags the live
                     # iterator by at most `staleness` batches, so a
                     # resume replays only freshly-generated experience.
-                    trainer.save_checkpoint(data_state=item.data_state)
+                    trainer.save_checkpoint(data_state=item.data_state,
+                                            eval_iter=eval_iter)
         finally:
             prof.stop()
             self._stop.set()

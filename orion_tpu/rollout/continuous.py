@@ -222,6 +222,11 @@ class ContinuousBatchingEngine:
             # (XLA lowers the layout change to ICI transfers).
             self._jit_prep = jax.jit(
                 prep, out_shardings=self._param_shardings)
+        # Drop the previous cache FIRST: holding the old raw snapshot +
+        # old prepared tree while materializing the new one would put
+        # four weight-sized trees on the rollout mesh at refresh time.
+        self._prep_src = None
+        self._prep_out = None
         with self._ctx():
             out = self._jit_prep(params)
         self._prep_src = params

@@ -312,6 +312,14 @@ class BaseTrainer:
         return self.engine.generate(ids, lens, rng,
                                     params=self.state.params)
 
+    def _score_result(self, result, host, meta) -> np.ndarray:
+        """One place for the device-vs-host reward dispatch (the
+        wants_device_result contract) — used by make_experience,
+        evaluate, and the async rollout loop."""
+        wants_device = getattr(self.reward_fn, "wants_device_result",
+                               False)
+        return self.score(result if wants_device else host, meta)
+
     def score(self, result: GenerationResult, batch: dict) -> np.ndarray:
         """Sequence-level scores [B] as host f32.  ``result`` should be
         the host copy (``GenerationResult.to_host()``) unless the reward
@@ -388,8 +396,7 @@ class BaseTrainer:
             self._finalize_iteration(meta_p, fetched["p"],
                                      now=meta_p["t_next"])
         host = GenerationResult(**fetched["r"])
-        wants_device = getattr(self.reward_fn, "wants_device_result", False)
-        scores = self.score(result if wants_device else host, meta)
+        scores = self._score_result(result, host, meta)
         return self.build_experience(result, scores, host=host)
 
     def _epochs_fn(self, state: TrainState, experience, idx_mat):
@@ -465,9 +472,7 @@ class BaseTrainer:
             rng, sub = jax.random.split(rng)
             result = self.generate(ids, plens, rng=sub)
             host = result.to_host()
-            wants_device = getattr(self.reward_fn,
-                                   "wants_device_result", False)
-            scores = self.score(result if wants_device else host, meta)
+            scores = self._score_result(result, host, meta)
             rewards.append(np.asarray(scores, np.float32))
             lens.append(np.asarray(host.completion_lens, np.float32))
         rewards = np.concatenate(rewards)

@@ -80,3 +80,34 @@ def test_launch_eval_every(tmp_path):
     lines = [json.loads(line) for line in
              open(tmp_path / "logs" / "metrics.jsonl")]
     assert any("eval_reward_mean" in row for row in lines), lines
+
+
+def test_async_eval_every():
+    """Async mode: eval runs on the learner's own (train-mesh) engine
+    on schedule — the rollout group's engine is never raced."""
+    from orion_tpu.config import MeshConfig
+    from orion_tpu.models.sharded import make_sharded_model
+    from orion_tpu.orchestration.async_orchestrator import (
+        AsyncOrchestrator, split_devices)
+    from orion_tpu.parallel.mesh import make_mesh
+    import jax.numpy as jnp
+
+    rdev, tdev = split_devices(jax.devices(), 4)
+    cfg = _mk(GRPOConfig, group_size=2, kl_coef=0.0, num_epochs=1,
+              minibatch_size=4, eval_every=2)
+    cfg.async_mode = True
+    cfg.async_staleness = 1
+    model = Transformer(cfg.model)
+    tmesh = make_mesh(MeshConfig(data=1, fsdp=-1, seq=1, tensor=1),
+                      devices=tdev)
+    with tmesh:
+        params, _ = make_sharded_model(
+            model, tmesh, jax.random.key(0),
+            (jnp.zeros((1, 2), jnp.int32), jnp.zeros((1, 2), jnp.int32)))
+        tr = GRPOTrainer(cfg, model, params,
+                         reward_fn=lucky_token_reward, eos_token_id=None)
+        orch = AsyncOrchestrator(tr, rdev)
+        hist = orch.train(prompt_stream(8, 5), num_iterations=4,
+                          eval_iter=prompt_stream(4, 5, seed=9))
+    evals = [h for h in hist if "eval_reward_mean" in h]
+    assert len(evals) == 2, [sorted(h) for h in hist]
