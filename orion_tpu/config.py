@@ -217,6 +217,10 @@ class RolloutConfig:
     # be > 0 — NOT the top_k-style "0 disables" convention (0 would
     # divide logits by zero); validated in __post_init__.
     repetition_penalty: float = 1.0
+    # Extra terminator token ids beyond eos_token_id (vLLM
+    # stop_token_ids): sampling any of them ends the sequence.  The
+    # stop token itself is kept in the completion, like EOS.
+    stop_token_ids: tuple = ()
     # Paged KV cache for RolloutEngine: capacity in pages; page_size
     # tokens per page.  Default False: for fixed-batch generate the
     # dense cache is ~2.6x faster on-chip (measured v5e, B=32/L=256 —
@@ -247,7 +251,24 @@ class RolloutConfig:
     quantize_weights: bool = False
     quantize_kv: bool = False
 
+    def effective_min_new(self, eos_id) -> int:
+        """min_new_tokens is only meaningful when SOME terminator can
+        fire (eos or stop_token_ids) — the single source of truth for
+        the engines' gating."""
+        return (self.min_new_tokens
+                if eos_id is not None or self.stop_token_ids else 0)
+
     def __post_init__(self) -> None:
+        # Normalize stop_token_ids: yaml scalars arrive as a bare int,
+        # CLI overrides as floats — engines iterate a tuple of ints.
+        ids = self.stop_token_ids
+        if isinstance(ids, (int, float)):
+            ids = (ids,)
+        self.stop_token_ids = tuple(int(t) for t in ids)
+        if any(t < 0 for t in self.stop_token_ids):
+            raise ValueError(
+                f"stop_token_ids must be non-negative, got "
+                f"{self.stop_token_ids}")
         if self.repetition_penalty <= 0:
             raise ValueError(
                 f"repetition_penalty must be > 0 (1.0 disables), got "

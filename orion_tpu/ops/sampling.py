@@ -69,12 +69,29 @@ def seen_from_prompts(prompt_ids: jnp.ndarray, prompt_lens: jnp.ndarray,
         jnp.arange(B)[:, None], safe].set(True, mode="drop")
 
 
-def eos_forbid_mask(batch: int, vocab_size: int, eos_id: int,
-                    under_min) -> jnp.ndarray:
-    """[B, V] bool mask suppressing EOS for sequences still under
-    min_new_tokens (``under_min``: scalar or [B] bool)."""
-    return jnp.zeros((batch, vocab_size), bool).at[:, eos_id].set(
-        under_min)
+def eos_forbid_mask(batch: int, vocab_size: int, eos_id,
+                    under_min, stop_ids: tuple = ()) -> jnp.ndarray:
+    """[B, V] bool mask suppressing EVERY terminator (eos + configured
+    stop_token_ids, vLLM min_tokens semantics) for sequences still
+    under min_new_tokens (``under_min``: scalar or [B] bool)."""
+    m = jnp.zeros((batch, vocab_size), bool)
+    for t in (eos_id, *stop_ids):
+        if t is not None:
+            m = m.at[:, int(t)].set(under_min)
+    return m
+
+
+def is_stop_token(tokens: jnp.ndarray, eos_id,
+                  stop_ids: tuple) -> jnp.ndarray:
+    """[B] bool: token terminates its sequence (eos or any of the
+    configured stop_token_ids).  eos_id None with no stop_ids => all
+    False."""
+    done = jnp.zeros(tokens.shape, bool)
+    if eos_id is not None:
+        done = tokens == eos_id
+    for sid in stop_ids:
+        done = done | (tokens == int(sid))
+    return done
 
 
 def sample_tokens(rng: jax.Array, logits: jnp.ndarray, temperature: float,

@@ -32,7 +32,8 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from orion_tpu.config import ModelConfig, RolloutConfig
-from orion_tpu.ops.sampling import sample_tokens
+from orion_tpu.ops.sampling import (eos_forbid_mask, is_stop_token,
+                                    sample_tokens, seen_from_prompts)
 from orion_tpu.runtime import Scheduler
 
 
@@ -285,10 +286,7 @@ class ContinuousBatchingEngine:
         last = logits[:, 0]
         V = last.shape[-1]
         pen = self.cfg.repetition_penalty != 1.0
-        min_new = self.cfg.min_new_tokens if self.eos is not None else 0
-        from orion_tpu.ops.sampling import (eos_forbid_mask,
-                                            seen_from_prompts)
-
+        min_new = self.cfg.effective_min_new(self.eos)
         kw = {}
         if pen:
             # wave-level seen set from the admitted prompts
@@ -297,12 +295,12 @@ class ContinuousBatchingEngine:
                   "repetition_penalty": self.cfg.repetition_penalty}
         if min_new > 0:
             # generated count is 0 at admission: EOS always suppressed
-            kw["forbid"] = eos_forbid_mask(B, V, self.eos, True)
+            kw["forbid"] = eos_forbid_mask(B, V, self.eos, True,
+                                           self.cfg.stop_token_ids)
         tok0, lp0, plp0 = sample_tokens(
             rng, last, temperature=self.cfg.temperature,
             top_k=self.cfg.top_k, top_p=self.cfg.top_p, **kw)
-        d0 = (tok0 == self.eos) if self.eos is not None else \
-            jnp.zeros((B,), bool)
+        d0 = is_stop_token(tok0, self.eos, self.cfg.stop_token_ids)
         st = dict(state)
         if pen:
             wave_seen = wave_seen.at[jnp.arange(B), tok0].set(True)
@@ -349,17 +347,15 @@ class ContinuousBatchingEngine:
             rng, sub = jax.random.split(rng)
             V = logits.shape[-1]
             pen = self.cfg.repetition_penalty != 1.0
-            min_new = (self.cfg.min_new_tokens
-                       if self.eos is not None else 0)
+            min_new = self.cfg.effective_min_new(self.eos)
             kw = {}
             if pen:
                 kw = {"seen": st["seen"],
                       "repetition_penalty": self.cfg.repetition_penalty}
             if min_new > 0:
-                from orion_tpu.ops.sampling import eos_forbid_mask
-
-                kw["forbid"] = eos_forbid_mask(S, V, self.eos,
-                                               st["n_new"] < min_new)
+                kw["forbid"] = eos_forbid_mask(
+                    S, V, self.eos, st["n_new"] < min_new,
+                    self.cfg.stop_token_ids)
             nxt, lp, plp = sample_tokens(
                 sub, logits[:, 0], temperature=self.cfg.temperature,
                 top_k=self.cfg.top_k, top_p=self.cfg.top_p, **kw)
@@ -380,8 +376,8 @@ class ContinuousBatchingEngine:
             st["lengths"] = st["lengths"] + live
             st["cur_tok"] = jnp.where(live, nxt, st["cur_tok"])
             done = st["done"] | (st["n_new"] >= st["budget"])
-            if self.eos is not None:
-                done = done | (live & (nxt == self.eos))
+            done = done | (live & is_stop_token(nxt, self.eos,
+                                                self.cfg.stop_token_ids))
             st["done"] = done
             return (self._strip(cache), st, rng)
 

@@ -162,11 +162,11 @@ class RolloutEngine:
         # carries a [B, V] seen-set (prompt tokens included, HF/vLLM
         # convention); min_new_tokens suppresses EOS until each
         # sequence has generated that many tokens.
-        from orion_tpu.ops.sampling import (eos_forbid_mask,
+        from orion_tpu.ops.sampling import (eos_forbid_mask, is_stop_token,
                                             seen_from_prompts)
 
         pen = cfg.repetition_penalty != 1.0
-        min_new = cfg.min_new_tokens if eos is not None else 0
+        min_new = cfg.effective_min_new(eos)
         bidx = jnp.arange(B)
         seen = seen_from_prompts(prompt_ids, prompt_lens, V) if pen \
             else jnp.zeros((B, 1), bool)  # carried but unused when off
@@ -177,8 +177,9 @@ class RolloutEngine:
                 kw["seen"] = seen
                 kw["repetition_penalty"] = cfg.repetition_penalty
             if min_new > 0:
-                kw["forbid"] = eos_forbid_mask(B, V, eos,
-                                               n_generated < min_new)
+                kw["forbid"] = eos_forbid_mask(
+                    B, V, eos, n_generated < min_new,
+                    cfg.stop_token_ids)
             return kw
 
         rng, sub = jax.random.split(rng)
@@ -189,7 +190,7 @@ class RolloutEngine:
         tokens = jnp.full((B, T), pad, jnp.int32).at[:, 0].set(tok0)
         logps = jnp.zeros((B, T), jnp.float32).at[:, 0].set(lp0)
         plogps = jnp.zeros((B, T), jnp.float32).at[:, 0].set(plp0)
-        done = jnp.zeros((B,), bool) if eos is None else (tok0 == eos)
+        done = is_stop_token(tok0, eos, cfg.stop_token_ids)
         comp_len = jnp.ones((B,), jnp.int32)
 
         def cond(c):
@@ -215,8 +216,7 @@ class RolloutEngine:
             logps = logps.at[:, t].set(lp, mode="drop")
             plogps = plogps.at[:, t].set(plp, mode="drop")
             comp_len = comp_len + (~done).astype(jnp.int32)
-            if eos is not None:
-                done = done | (nxt == eos)
+            done = done | is_stop_token(nxt, eos, cfg.stop_token_ids)
             return (t + 1, nxt, cur_pos + 1, rng, done, tokens, logps,
                     plogps, (cache, comp_len, seen))
 

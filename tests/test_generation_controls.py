@@ -158,3 +158,44 @@ def test_greedy_behavior_logprob_is_delta_under_controls():
     assert float(lp[0]) == 0.0
     raw = jax.nn.log_softmax(logits, axis=-1)
     np.testing.assert_allclose(float(plp[0]), float(raw[0, 2]), rtol=1e-6)
+
+
+def test_stop_token_ids_terminate_both_engines():
+    """stop_token_ids (vLLM): extra terminators beyond EOS; the stop
+    token stays in the completion like EOS does."""
+    _, _, base = _gen("simple", eos=None)
+    toks = np.asarray(base.completions)
+    stop = int(toks[0, 2])  # a token greedy decode actually emits
+    for kind in ("simple", "continuous"):
+        _, _, r = _gen(kind, eos=None, stop_token_ids=(stop,))
+        lens = np.asarray(r.completion_lens)
+        comp = np.asarray(r.completions)
+        assert (lens < 12).any(), (kind, lens)
+        for b in range(comp.shape[0]):
+            row = comp[b, :lens[b]]
+            # nothing AFTER a stop token: it may only appear last
+            assert not np.isin(row[:-1], [stop]).any(), (kind, row)
+
+
+def test_min_new_tokens_suppresses_stop_ids_too():
+    _, _, base = _gen("simple", eos=None)
+    stop = int(np.asarray(base.completions)[0, 1])
+    _, _, r0 = _gen("simple", eos=None, stop_token_ids=(stop,))
+    assert (np.asarray(r0.completion_lens) < 8).any(), \
+        "premise broken: stop id never fires early"
+    _, _, r1 = _gen("simple", eos=None, stop_token_ids=(stop,),
+                    min_new_tokens=8)
+    assert (np.asarray(r1.completion_lens) >= 8).all(), \
+        np.asarray(r1.completion_lens)
+
+
+def test_stop_token_ids_normalized():
+    """YAML scalars (bare int) and CLI floats normalize to int tuples;
+    negatives rejected."""
+    import pytest
+
+    assert RolloutConfig(stop_token_ids=50256).stop_token_ids == (50256,)
+    assert RolloutConfig(
+        stop_token_ids=(50256.0, 1.0)).stop_token_ids == (50256, 1)
+    with pytest.raises(ValueError, match="non-negative"):
+        RolloutConfig(stop_token_ids=(-1,))
