@@ -28,7 +28,6 @@ from __future__ import annotations
 
 import json
 import os
-import subprocess
 import sys
 import time
 
@@ -38,28 +37,12 @@ V5E_PEAK_FLOPS = 197e12  # bf16 dense, one v5e chip
 
 
 def _probe_backend(timeout: float = 90, attempts: int = 2):
-    """(backend, error): initialize jax's default backend in a
-    SUBPROCESS with a hard timeout.  A sick axon tunnel hangs forever
-    inside ``make_c_api_client`` (r3: the judge blocked 240s; the
-    driver's bench artifact was rc=1 with a raw traceback) — in-process
-    try/except catches errors, not hangs, so the probe must be a child
-    process we can kill.  Bounded retry, then CPU fallback with the
-    reason recorded for the bench JSON."""
-    reason = ""
-    for i in range(attempts):
-        try:
-            r = subprocess.run(
-                [sys.executable, "-c",
-                 "import jax; print(jax.default_backend())"],
-                capture_output=True, text=True, timeout=timeout)
-            if r.returncode == 0 and r.stdout.strip():
-                return r.stdout.strip().splitlines()[-1], ""
-            reason = (f"backend init rc={r.returncode}: "
-                      f"{r.stderr.strip()[-200:]}")
-        except subprocess.TimeoutExpired:
-            reason = (f"backend init hang >{timeout:.0f}s "
-                      f"(attempt {i + 1}/{attempts})")
-    return "cpu", reason
+    """Shared subprocess probe (orion_tpu.utils.platform) — a sick
+    axon tunnel HANGS (r3: rc=1 artifact, judge blocked 240 s), and
+    only a killable child process defends against a hang."""
+    from orion_tpu.utils.platform import probe_backend
+
+    return probe_backend(timeout=timeout, attempts=attempts)
 
 
 def _pin_cpu() -> None:

@@ -12,7 +12,48 @@ its first ``jax.devices()``/jit dispatch.
 from __future__ import annotations
 
 import os
-from typing import Optional
+import subprocess
+import sys
+from typing import Optional, Tuple
+
+
+def probe_backend(timeout: float = 90, attempts: int = 2) -> Tuple[str, str]:
+    """(backend, error): initialize jax's default backend in a
+    SUBPROCESS with a hard timeout.  A sick axon tunnel hangs forever
+    inside ``make_c_api_client`` — in-process try/except catches
+    errors, not hangs, so the probe must be a child process we can
+    kill.  Bounded retry, then ("cpu", reason)."""
+    reason = ""
+    for i in range(attempts):
+        try:
+            r = subprocess.run(
+                [sys.executable, "-c",
+                 "import jax; print(jax.default_backend())"],
+                capture_output=True, text=True, timeout=timeout)
+            if r.returncode == 0 and r.stdout.strip():
+                return r.stdout.strip().splitlines()[-1], ""
+            reason = (f"backend init rc={r.returncode}: "
+                      f"{r.stderr.strip()[-200:]}")
+        except subprocess.TimeoutExpired:
+            reason = (f"backend init hang >{timeout:.0f}s "
+                      f"(attempt {i + 1}/{attempts})")
+    return "cpu", reason
+
+
+def ensure_live_backend(timeout: float = 90) -> str:
+    """Probe the default backend; pin this process to CPU only if the
+    probe FAILED (hang/error).  Returns the backend that will serve.
+    Entry points that would otherwise block forever on first dispatch
+    (driver hooks, benches) call this before touching jax.  The
+    fallback is LOUD — a sick chip must never masquerade as a healthy
+    compile-check."""
+    backend, err = probe_backend(timeout=timeout)
+    if err:
+        print(f"[orion-tpu] WARNING: default backend unusable "
+              f"({err}); pinning CPU", file=sys.stderr, flush=True)
+        force_cpu_platform()
+        return "cpu"
+    return backend
 
 
 def force_cpu_platform(n_devices: Optional[int] = None) -> None:
