@@ -181,3 +181,34 @@ def test_deferred_pipeline_kl_controller_order():
     assert len(calls) == n, f"kl_ctl.update called {len(calls)} times"
     # history stats carry the same kl values the controller saw, in order
     np.testing.assert_allclose([h["kl"] for h in hist], calls, rtol=1e-6)
+
+
+def test_deferred_pipeline_matches_eager_trajectory():
+    """train()'s deferred-stats pipeline (the r3 throughput machinery)
+    must be a pure SCHEDULING change: same seeds through the eager
+    make_experience/update_epochs composition (what the async learner
+    uses) yield bit-identical final params."""
+    def mk():
+        cfg = _mk(PPOConfig, share_backbone=True, adaptive_kl=True,
+                  kl_coef=0.1, kl_target=0.01, kl_horizon=100,
+                  num_epochs=1)
+        model = ActorCriticModel(cfg.model)
+        params = init_params(model, jax.random.key(0), cfg.model)
+        return PPOTrainer(cfg, model, params,
+                          reward_fn=lucky_token_reward, eos_token_id=None)
+
+    n = 3
+    tr_a = mk()
+    tr_a.train(prompt_stream(8, 5), num_iterations=n)
+
+    tr_b = mk()
+    it = prompt_stream(8, 5)
+    for _ in range(n):
+        experience, _ = tr_b.make_experience(next(it))
+        tr_b.update_epochs(experience)
+        tr_b.sync_weights()
+
+    for a, b in zip(jax.tree.leaves(tr_a.state.params),
+                    jax.tree.leaves(tr_b.state.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert abs(tr_a.kl_ctl.value - tr_b.kl_ctl.value) < 1e-9
