@@ -619,6 +619,13 @@ class BaseTrainer:
                     "it": it, "giter": self.global_iter,
                     "t0": t0, "t1": t1, "t2": t2,
                 }
+                # Held-out eval on schedule (generates with the
+                # freshest weights — sync_weights already ran).  Eval
+                # runs BEFORE a same-step checkpoint so the saved eval
+                # cursor includes this step's eval — otherwise a resume
+                # replays it, and the resumed run's eval-reward series
+                # diverges from an uninterrupted one.
+                self._maybe_evaluate(eval_iter)
                 if self.ckpt is not None and \
                         self.global_iter % self.cfg.checkpoint_every == 0:
                     # Materialize this iteration's stats first so the
@@ -631,9 +638,6 @@ class BaseTrainer:
                                              now=time.perf_counter())
                     pending = None
                     self.save_checkpoint(prompt_iter, eval_iter=eval_iter)
-                # Held-out eval on schedule (generates with the
-                # freshest weights — sync_weights already ran).
-                self._maybe_evaluate(eval_iter)
             if pending is not None:  # flush the last iteration's stats
                 fetched = jax.device_get(pending["dev"])
                 self._finalize_iteration(pending, fetched,

@@ -111,3 +111,36 @@ def test_async_eval_every():
                           eval_iter=prompt_stream(4, 5, seed=9))
     evals = [h for h in hist if "eval_reward_mean" in h]
     assert len(evals) == 2, [sorted(h) for h in hist]
+
+
+def test_eval_cursor_checkpoint_roundtrip(tmp_path):
+    """The eval iterator's cursor rides the checkpoint and restores on
+    resume — a resumed run continues the shuffled eval epoch instead of
+    replaying its head."""
+    from orion_tpu.data import ByteTokenizer, build_prompt_iterator
+
+    def eval_it():
+        return build_prompt_iterator("synthetic", ByteTokenizer(),
+                                     batch_size=2, max_prompt_len=16,
+                                     synthetic_size=12, seed=9)
+
+    from orion_tpu.config import ModelConfig
+
+    model260 = ModelConfig.tiny(vocab_size=260, hidden_size=32,
+                                intermediate_size=64, num_layers=2,
+                                num_heads=2, num_kv_heads=2,
+                                dtype="float32")
+    cfg, tr = _trainer(eval_every=2, checkpoint_dir=str(tmp_path / "ck"),
+                       checkpoint_every=2, model=model260)
+    e1 = eval_it()
+    tr.train(prompt_stream(8, 5), num_iterations=2, eval_iter=e1)
+    tr.ckpt.wait()
+    saved_cursor = e1.state()
+    assert saved_cursor["cursor"] > 0  # the eval actually consumed rows
+
+    _, tr2 = _trainer(eval_every=2, checkpoint_dir=str(tmp_path / "ck"),
+                      checkpoint_every=2, model=model260)
+    e2 = eval_it()
+    assert e2.state() != saved_cursor
+    assert tr2.resume(eval_iter=e2)
+    assert e2.state() == saved_cursor
