@@ -250,6 +250,12 @@ class RolloutConfig:
     # training graph is never quantized.
     quantize_weights: bool = False
     quantize_kv: bool = False
+    # Shared-prefix group admission (continuous engine): when a trainer
+    # samples k completions per prompt (GRPO/RLOO/Online-DPO), prefill
+    # each unique prompt once and share its fully-filled prompt pages
+    # across the k clones' block tables — prefill FLOPs and prompt-page
+    # HBM drop ~k×.  False = admit k independent clones (A/B baseline).
+    group_prefix_sharing: bool = True
 
     def effective_min_new(self, eos_id) -> int:
         """min_new_tokens is only meaningful when SOME terminator can
@@ -257,6 +263,26 @@ class RolloutConfig:
         the engines' gating."""
         return (self.min_new_tokens
                 if eos_id is not None or self.stop_token_ids else 0)
+
+    def check_stop_ids(self, vocab_size: int, eos_id=None) -> None:
+        """Engine-construction check (ADVICE r4): an out-of-vocab stop
+        or EOS id can never be sampled, so ``is_stop_token`` never
+        fires and the ``eos_forbid_mask`` scatter drops — a config typo
+        (or a tokenizer/model vocab mismatch) would silently disable
+        the terminator."""
+        # (negative stop ids are already rejected in __post_init__ —
+        # only the upper bound needs the engine's vocab size)
+        bad = [t for t in self.stop_token_ids if t >= vocab_size]
+        if bad:
+            raise ValueError(
+                f"stop_token_ids {bad} out of range for "
+                f"vocab_size={vocab_size}: they could never be sampled, "
+                "silently disabling the terminator")
+        if eos_id is not None and not 0 <= int(eos_id) < vocab_size:
+            raise ValueError(
+                f"eos_token_id {eos_id} out of range for "
+                f"vocab_size={vocab_size}: it could never be sampled, "
+                "silently disabling the terminator")
 
     def __post_init__(self) -> None:
         # Normalize stop_token_ids: yaml scalars arrive as a bare int,

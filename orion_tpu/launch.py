@@ -36,7 +36,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from orion_tpu.config import (GRPOConfig, ModelConfig, OnlineDPOConfig,
-                              PPOConfig, RLOOConfig, load_config)
+                              PPOConfig, RLOOConfig, RolloutConfig,
+                              load_config)
 from orion_tpu.data import build_prompt_iterator
 from orion_tpu.data.prompts import load_tokenizer
 from orion_tpu.models import (ScalarHeadModel, Transformer)
@@ -83,6 +84,66 @@ def build_reward(cfg, tokenizer, mesh):
         params, _ = make_sharded_model(rm, mesh, jax.random.key(1),
                                        _INIT_ARGS, host_params=host)
         return ModelReward(rm, params)
+    if spec.startswith("judge:"):
+        # Generative pairwise judge (SURVEY.md §2 #2 "RM/judge"): a
+        # causal LM prompted for an A/B verdict through the rollout
+        # engine — requires group_size=2 sampling (Online-DPO pairs).
+        if getattr(cfg, "group_size", None) != 2:
+            raise ValueError(
+                "reward=judge:... scores PAIRS: it requires "
+                f"group_size=2, got {getattr(cfg, 'group_size', None)} "
+                "(the judge compares the two completions of each "
+                "prompt)")
+        path = spec.split(":", 1)[1]
+        from orion_tpu.models.hf_loader import config_from_hf
+        from orion_tpu.rewards import JudgeReward
+        from transformers import AutoConfig
+
+        j_cfg = config_from_hf(AutoConfig.from_pretrained(path))
+        judge = Transformer(j_cfg)
+        host = load_hf_pretrained(path, j_cfg)
+        params, _ = make_sharded_model(judge, mesh, jax.random.key(2),
+                                       _INIT_ARGS, host_params=host)
+        # The judge must read/write ITS OWN vocabulary: prefer the
+        # tokenizer shipped with the judge checkpoint; only fall back
+        # to the policy tokenizer when the vocabularies provably match
+        # (a cross-family tokenizer would encode the comparison prompt
+        # into the wrong ids and every verdict would be noise).
+        try:
+            j_tok = load_tokenizer(path)
+        except (OSError, ValueError):
+            j_tok = tokenizer
+            if getattr(tokenizer, "vocab_size", None) is not None and \
+                    tokenizer.vocab_size > j_cfg.vocab_size:
+                raise ValueError(
+                    f"reward=judge:{path}: judge ships no tokenizer and "
+                    f"the policy tokenizer (vocab {tokenizer.vocab_size})"
+                    f" does not fit the judge vocab {j_cfg.vocab_size}")
+            import warnings
+
+            # A size check cannot prove the vocabularies MATCH — a
+            # cross-family tokenizer with a smaller vocab would encode
+            # the comparison prompt into wrong ids and every verdict
+            # would be noise.  Degrade loudly, never silently.
+            warnings.warn(
+                f"reward=judge:{path}: judge ships no tokenizer; "
+                "reusing the POLICY tokenizer.  This is only correct "
+                "when the judge shares the policy's vocabulary — a "
+                "cross-family judge will produce noise verdicts.",
+                stacklevel=2)
+        judge_ctx = (cfg.rollout.max_prompt_len
+                     + 2 * cfg.rollout.max_new_tokens + 128)
+        if judge_ctx + 4 > j_cfg.max_seq_len:
+            raise ValueError(
+                f"reward=judge:{path}: comparison prompts need "
+                f"{judge_ctx}+4 tokens of context but the judge's "
+                f"max_seq_len is {j_cfg.max_seq_len}; shrink "
+                "rollout.max_prompt_len/max_new_tokens or pick a "
+                "longer-context judge")
+        rcfg = RolloutConfig(max_prompt_len=judge_ctx,
+                             max_new_tokens=4, temperature=0.0)
+        return JudgeReward(judge, j_cfg, params, j_tok,
+                           rollout_cfg=rcfg)
     raise ValueError(f"unknown reward spec: {spec!r}")
 
 

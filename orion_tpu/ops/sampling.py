@@ -116,7 +116,11 @@ def sample_tokens(rng: jax.Array, logits: jnp.ndarray, temperature: float,
     """
     logits = logits.astype(jnp.float32)
     raw_logps = jax.nn.log_softmax(logits, axis=-1)
-    transformed = repetition_penalty != 1.0 or forbid is not None
+    # A repetition penalty with no seen-set applies NO transform, so it
+    # must not flip greedy decoding into delta-distribution logprob
+    # accounting (ADVICE r4).
+    transformed = (seen is not None and repetition_penalty != 1.0) \
+        or forbid is not None
     if seen is not None and repetition_penalty != 1.0:
         logits = apply_repetition_penalty(logits, seen,
                                           repetition_penalty)

@@ -1,3 +1,4 @@
+from orion_tpu.rewards.judge import JudgeReward  # noqa: F401
 from orion_tpu.rewards.reward_model import ModelReward  # noqa: F401
 from orion_tpu.rewards.math_verifier import (  # noqa: F401
     MathVerifierReward,

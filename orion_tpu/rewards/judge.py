@@ -1,0 +1,166 @@
+"""Generative pairwise judge (SURVEY.md §2 #2 "score with RM/judge",
+VERDICT r4 missing #6): score Online-DPO sampling pairs by PROMPTING a
+judge model through the rollout engine and parsing its verdict, the
+LLM-as-judge alternative to a scalar reward model.
+
+The judge is an ordinary causal LM driven by an ordinary
+:class:`RolloutEngine` (greedy, few tokens) — no new device code.  Per
+prompt-pair it sees one comparison prompt built from a template and
+must answer with the letter of the better response; the pair's scores
+become (1, 0) / (0, 1), or (0.5, 0.5) when the verdict does not parse
+(an unparsable judgment must not bias the DPO preference either way).
+
+Position bias note: a single A/B ordering is the cheap variant; the
+template keeps the instruction closest to the verdict slot.  Swapping
+orders and averaging doubles judge cost and is left to the caller (run
+the reward twice with ``swap=True``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import numpy as np
+
+from orion_tpu.config import ModelConfig, RolloutConfig
+from orion_tpu.rollout import GenerationResult
+
+DEFAULT_TEMPLATE = (
+    "Compare the two responses to the instruction and answer with the "
+    "single letter of the better response.\n"
+    "Instruction:\n{prompt}\n\n"
+    "Response A:\n{a}\n\n"
+    "Response B:\n{b}\n\n"
+    "Better response (A or B):"
+)
+
+
+class JudgeReward:
+    """reward_fn scoring group_size=2 rollouts with a generative judge.
+
+    Args:
+      model / model_cfg / params: the judge LM (any Transformer the
+        models layer can build, e.g. an HF import).
+      tokenizer: HF-style tokenizer shared with the judge model.
+      rollout_cfg: engine settings for the verdict generation; default
+        is greedy with a handful of new tokens.
+      template: comparison prompt with {prompt}/{a}/{b} slots.
+      swap: present the pair as (B, A) instead — run both orders and
+        average the two scores to cancel position bias.
+    """
+
+    # Scores on the host copy: the verdict path re-tokenizes decoded
+    # text, so device sequences buy nothing here.
+    wants_device_result = False
+
+    def __init__(self, model: Any, model_cfg: ModelConfig, params: Any,
+                 tokenizer: Any,
+                 rollout_cfg: Optional[RolloutConfig] = None,
+                 template: str = DEFAULT_TEMPLATE, swap: bool = False):
+        from orion_tpu.rollout import RolloutEngine
+
+        self.tok = tokenizer
+        self.template = template
+        self.swap = swap
+        if rollout_cfg is None:
+            rollout_cfg = RolloutConfig(
+                max_prompt_len=768, max_new_tokens=4, temperature=0.0)
+        self.cfg = rollout_cfg
+        eos = getattr(tokenizer, "eos_token_id", None)
+        pad = getattr(tokenizer, "pad_token_id", 0) or 0
+        self.engine = RolloutEngine(model, model_cfg, rollout_cfg,
+                                    eos_token_id=eos, pad_token_id=pad)
+        self.engine.load_weights(params)
+        # Letter token ids for verdict parsing (with and without the
+        # leading space most BPE vocabularies attach).
+        self._a_ids = self._letter_ids("A")
+        self._b_ids = self._letter_ids("B")
+        if not self._a_ids and not self._b_ids:
+            # with no parsable letters every verdict would score 0.5
+            # and DPO would train on a constant zero preference —
+            # degrade loudly, never silently.
+            raise ValueError(
+                "JudgeReward: the judge tokenizer encodes neither 'A' "
+                "nor 'B' as a single token; verdicts could never be "
+                "parsed.  Use a different template/tokenizer.")
+
+    def _letter_ids(self, letter: str) -> set:
+        out = set()
+        unk = getattr(self.tok, "unk_token_id", None)
+        for text in (letter, " " + letter):
+            ids = self.tok.encode(text, add_special_tokens=False)
+            # a letter the vocab can't represent must never alias to
+            # <unk> — any unknown word in the verdict would then parse
+            # as that letter
+            if len(ids) == 1 and ids[0] != unk:
+                out.add(int(ids[0]))
+        return out
+
+    # -- helpers --------------------------------------------------------
+    def _decode_rows(self, ids: np.ndarray, lens: np.ndarray) -> list:
+        return self.tok.batch_decode(
+            [row[:n].tolist() for row, n in zip(ids, lens)],
+            skip_special_tokens=True)
+
+    def _verdicts(self, judge_prompts: list) -> np.ndarray:
+        """[n_pairs] float: 1.0 → first response, 0.0 → second,
+        0.5 → unparsable."""
+        P = self.cfg.max_prompt_len
+        enc = [self.tok.encode(t, add_special_tokens=False)
+               for t in judge_prompts]
+        # keep the TAIL on overflow: the verdict slot is at the end
+        enc = [e[-P:] for e in enc]
+        n = len(enc)
+        ids = np.full((n, P), self.engine.pad_token_id, np.int32)
+        lens = np.zeros((n,), np.int32)
+        for i, e in enumerate(enc):
+            ids[i, : len(e)] = e
+            lens[i] = len(e)
+        # Same placement rule as BaseTrainer.generate: replicated on
+        # the judge-params mesh (multi-controller correctness).
+        from orion_tpu.utils.placement import replicated_put
+
+        ids_d, lens_d = replicated_put(
+            (ids, lens), getattr(self.engine, "_params", None))
+        out = self.engine.generate(ids_d, lens_d, jax.random.key(0))
+        comp = np.asarray(out.completions)
+        comp_lens = np.asarray(out.completion_lens)
+        scores = np.full((n,), 0.5, np.float32)
+        for i in range(n):
+            for t in comp[i, : comp_lens[i]]:
+                if int(t) in self._a_ids:
+                    scores[i] = 1.0
+                    break
+                if int(t) in self._b_ids:
+                    scores[i] = 0.0
+                    break
+        return scores
+
+    # -- reward_fn contract ---------------------------------------------
+    def __call__(self, result: GenerationResult, meta: dict) -> np.ndarray:
+        comps = np.asarray(result.completions)
+        comp_lens = np.asarray(result.completion_lens)
+        seqs = np.asarray(result.sequences)
+        plens = np.asarray(result.prompt_lens)
+        B = comps.shape[0]
+        if B % 2:
+            raise ValueError(
+                f"JudgeReward scores PAIRS (group_size=2); got batch {B}")
+        texts = self._decode_rows(comps, comp_lens)
+        # pairs share a prompt — decode only the even rows' prompts
+        prompts = self._decode_rows(seqs[0::2], plens[0::2])
+        judge_prompts = []
+        for i in range(0, B, 2):
+            a, b = texts[i], texts[i + 1]
+            if self.swap:
+                a, b = b, a
+            judge_prompts.append(self.template.format(
+                prompt=prompts[i // 2], a=a, b=b))
+        first = self._verdicts(judge_prompts)
+        if self.swap:
+            first = 1.0 - first
+        scores = np.zeros((B,), np.float32)
+        scores[0::2] = first
+        scores[1::2] = 1.0 - first
+        return scores

@@ -69,6 +69,7 @@ class RolloutEngine:
         self.model = model
         self.model_cfg = model_cfg
         self.cfg = cfg
+        cfg.check_stop_ids(model_cfg.vocab_size, eos_token_id)
         self.eos_token_id = eos_token_id
         self.pad_token_id = pad_token_id
         self._params = None

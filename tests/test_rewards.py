@@ -55,3 +55,162 @@ def test_model_reward_runs():
     res2 = _fake_result(comps, [6, 4, 1])
     scores2 = np.asarray(reward(res2, {}))
     assert scores[2] != scores2[2]
+
+
+# ---------------------------------------------------------------------------
+# Generative pairwise judge (SURVEY.md §2 #2 "RM/judge")
+# ---------------------------------------------------------------------------
+class _AsciiTok:
+    """Minimal HF-shaped tokenizer: token id == ascii code."""
+
+    eos_token_id = None
+    pad_token_id = 0
+    unk_token_id = None
+
+    def encode(self, text, add_special_tokens=False):
+        return [ord(c) for c in text]
+
+    def batch_decode(self, rows, skip_special_tokens=True):
+        return ["".join(chr(int(t)) for t in row if int(t) > 0)
+                for row in rows]
+
+
+class _StubEngine:
+    """Stands in for the judge's RolloutEngine: returns a scripted
+    verdict per judge prompt."""
+
+    pad_token_id = 0
+
+    def __init__(self, verdicts):
+        self.verdicts = verdicts  # list of strings
+        self.seen_prompts = None
+
+    def generate(self, ids, lens, rng, params=None):
+        import numpy as _np
+
+        ids = _np.asarray(ids)
+        lens = _np.asarray(lens)
+        self.seen_prompts = ["".join(chr(int(t)) for t in row[:n])
+                             for row, n in zip(ids, lens)]
+        T = 4
+        comp = _np.zeros((len(self.seen_prompts), T), _np.int32)
+        clens = _np.zeros((len(self.seen_prompts),), _np.int32)
+        for i, v in enumerate(self.verdicts):
+            for j, c in enumerate(v[:T]):
+                comp[i, j] = ord(c)
+            clens[i] = min(len(v), T)
+        from orion_tpu.rollout.engine import GenerationResult
+
+        z = _np.zeros_like(comp, _np.float32)
+        return GenerationResult(
+            sequences=comp, completions=comp,
+            completion_mask=(comp > 0).astype(_np.float32),
+            completion_lens=clens, logprobs=z, policy_logprobs=z,
+            prompt_lens=lens, total_lens=lens + clens)
+
+
+def _pair_result(comp_texts, prompt_text="say hi"):
+    tok = _AsciiTok()
+    B = len(comp_texts)
+    P = len(prompt_text)
+    T = max(len(t) for t in comp_texts)
+    prompt_ids = np.asarray([[ord(c) for c in prompt_text]] * B, np.int32)
+    comps = np.zeros((B, T), np.int32)
+    clens = np.zeros((B,), np.int32)
+    for i, t in enumerate(comp_texts):
+        comps[i, : len(t)] = [ord(c) for c in t]
+        clens[i] = len(t)
+    seqs = np.concatenate([prompt_ids, comps], axis=1)
+    z = np.zeros_like(comps, np.float32)
+    return GenerationResult(
+        sequences=seqs, completions=comps,
+        completion_mask=(comps > 0).astype(np.float32),
+        completion_lens=clens, logprobs=z, policy_logprobs=z,
+        prompt_lens=np.full((B,), P, np.int32),
+        total_lens=np.full((B,), P, np.int32) + clens)
+
+
+def _stub_judge(verdicts, swap=False):
+    from orion_tpu.rewards import JudgeReward
+
+    j = JudgeReward.__new__(JudgeReward)
+    j.tok = _AsciiTok()
+    from orion_tpu.config import RolloutConfig
+
+    j.cfg = RolloutConfig(max_prompt_len=256, max_new_tokens=4,
+                          temperature=0.0)
+    j.template = __import__(
+        "orion_tpu.rewards.judge", fromlist=["DEFAULT_TEMPLATE"]
+    ).DEFAULT_TEMPLATE
+    j.swap = swap
+    j.engine = _StubEngine(verdicts)
+    j._a_ids = {ord("A")}
+    j._b_ids = {ord("B")}
+    return j
+
+
+def test_judge_reward_parses_verdicts():
+    res = _pair_result(["good answer", "bad answer",
+                        "meh", "great stuff",
+                        "x", "y"])
+    judge = _stub_judge(["A", " B", "??"])
+    scores = judge(res, {})
+    np.testing.assert_array_equal(
+        scores, [1.0, 0.0, 0.0, 1.0, 0.5, 0.5])
+    # the judge prompt must contain the instruction and BOTH responses
+    p = judge.engine.seen_prompts[0]
+    assert "say hi" in p and "good answer" in p and "bad answer" in p
+    assert p.index("good answer") < p.index("bad answer")
+
+
+def test_judge_reward_swap_cancels_position():
+    res = _pair_result(["r one", "r two"])
+    # swap presents (b, a); the stub says "A" (= r two) so row 1 wins
+    judge = _stub_judge(["A"], swap=True)
+    scores = judge(res, {})
+    np.testing.assert_array_equal(scores, [0.0, 1.0])
+    p = judge.engine.seen_prompts[0]
+    assert p.index("r two") < p.index("r one")
+
+
+def test_judge_reward_rejects_odd_batch():
+    import pytest
+
+    res = _pair_result(["a", "b", "c"])
+    judge = _stub_judge(["A", "A"])
+    with pytest.raises(ValueError, match="PAIRS"):
+        judge(res, {})
+
+
+def test_judge_reward_real_engine_tiny_model():
+    """End-to-end through a REAL RolloutEngine + tiny Transformer: the
+    verdicts are arbitrary (untrained judge) but every pair must score
+    (1,0), (0,1) or (0.5,0.5), bit-reproducibly."""
+    from orion_tpu.models import Transformer, init_params
+    from orion_tpu.rewards import JudgeReward
+    from orion_tpu.config import RolloutConfig
+
+    cfg = ModelConfig.tiny(vocab_size=512, hidden_size=32,
+                           intermediate_size=64, num_layers=2,
+                           num_heads=2, num_kv_heads=2, dtype="float32")
+
+    class _SmallTok(_AsciiTok):
+        unk_token_id = 1
+
+        def encode(self, text, add_special_tokens=False):
+            return [min(ord(c), 511) for c in text]
+
+    model = Transformer(cfg)
+    params = init_params(model, jax.random.key(0), cfg)
+    judge = JudgeReward(
+        model, cfg, params, _SmallTok(),
+        rollout_cfg=RolloutConfig(max_prompt_len=256, max_new_tokens=4,
+                                  temperature=0.0))
+    res = _pair_result(["alpha beta", "gamma delta",
+                        "one two", "three four"])
+    s1 = judge(res, {})
+    s2 = judge(res, {})
+    np.testing.assert_array_equal(s1, s2)
+    assert s1.shape == (4,)
+    for i in range(0, 4, 2):
+        assert (s1[i], s1[i + 1]) in ((1.0, 0.0), (0.0, 1.0), (0.5, 0.5))
