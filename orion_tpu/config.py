@@ -250,6 +250,16 @@ class RolloutConfig:
     # training graph is never quantized.
     quantize_weights: bool = False
     quantize_kv: bool = False
+    # Speculative decoding (simple engine, greedy): draft speculative_k
+    # tokens per step by prompt-lookup (match the trailing
+    # spec_ngram-gram against earlier sequence content) and verify all
+    # k+1 positions in ONE chunked forward — decode is HBM-bound, so a
+    # step that emits m+1 tokens reads the weights once instead of m+1
+    # times.  0 disables.  Prototype scope: temperature=0 (greedy
+    # acceptance is exact, output is bit-identical to plain greedy),
+    # dense cache, no repetition penalty / min_new_tokens.
+    speculative_k: int = 0
+    spec_ngram: int = 2
     # Shared-prefix group admission (continuous engine): when a trainer
     # samples k completions per prompt (GRPO/RLOO/Online-DPO), prefill
     # each unique prompt once and share its fully-filled prompt pages
@@ -300,6 +310,13 @@ class RolloutConfig:
                 f"repetition_penalty must be > 0 (1.0 disables), got "
                 f"{self.repetition_penalty} — this is NOT the "
                 "top_k-style 0-disables convention")
+        if self.speculative_k < 0:
+            raise ValueError(
+                f"speculative_k must be >= 0 (0 disables), got "
+                f"{self.speculative_k}")
+        if self.speculative_k > 0 and self.spec_ngram < 1:
+            raise ValueError(
+                f"spec_ngram must be >= 1, got {self.spec_ngram}")
         if not 0 <= self.min_new_tokens <= self.max_new_tokens:
             raise ValueError(
                 f"min_new_tokens={self.min_new_tokens} outside "

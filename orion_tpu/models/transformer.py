@@ -454,6 +454,30 @@ def maybe_unstack_for_decode(params: Any, cfg: ModelConfig):
     return unstack_params_tree(params, cfg.num_layers)
 
 
+def prep_decode_params(params: Any, cfg: ModelConfig,
+                       quantize_weights: bool = False):
+    """THE decode param-prep pipeline, shared by every engine path:
+    compute-dtype cast (so each decode step reads 2 bytes/param, not 4
+    + a per-op cast) → scan-layout unstack → optional int8 weight
+    quantization.  Each transform is idempotent, so pre-processed
+    trees pass through unchanged.  A prep-order change edits exactly
+    one place."""
+    import jax
+    import jax.numpy as jnp
+
+    cdt = jnp.dtype(cfg.dtype)
+    if cdt != jnp.dtype(cfg.param_dtype):
+        params = jax.tree.map(
+            lambda x: x.astype(cdt)
+            if jnp.issubdtype(x.dtype, jnp.floating) else x, params)
+    params = maybe_unstack_for_decode(params, cfg)
+    if quantize_weights:
+        from orion_tpu.ops.quant import quantize_params_int8
+
+        params = quantize_params_int8(params)
+    return params
+
+
 def unstack_params_tree(params: Any, num_layers: int):
     """jit-safe inverse of the scan_layers stacking: every subtree
     holding a stacked "layers" entry [L, ...] becomes layers_0..L-1

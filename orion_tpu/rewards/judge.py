@@ -109,7 +109,20 @@ class JudgeReward:
         P = self.cfg.max_prompt_len
         enc = [self.tok.encode(t, add_special_tokens=False)
                for t in judge_prompts]
-        # keep the TAIL on overflow: the verdict slot is at the end
+        over = sum(len(e) > P for e in enc)
+        if over:
+            import warnings
+
+            # keep the TAIL on overflow (the verdict slot is at the
+            # end) — but a truncated comparison loses the instruction
+            # header and part of response A, so degrade LOUDLY: size
+            # rollout_cfg.max_prompt_len to fit (launch.py's judge:
+            # path computes prompt + 2*completions + template slack).
+            warnings.warn(
+                f"JudgeReward: {over}/{len(enc)} comparison prompts "
+                f"exceed max_prompt_len={P} and were tail-truncated — "
+                "verdict quality degrades; raise "
+                "rollout_cfg.max_prompt_len", stacklevel=3)
         enc = [e[-P:] for e in enc]
         n = len(enc)
         ids = np.full((n, P), self.engine.pad_token_id, np.int32)

@@ -192,14 +192,6 @@ class ContinuousBatchingEngine:
         return state
 
     # -- weight hot-reload channel (trainer → rollout) ------------------
-    def _compute_cast(self, params):
-        cdt = jnp.dtype(self.mc.dtype)
-        if cdt == jnp.dtype(self.mc.param_dtype):
-            return params
-        return jax.tree.map(
-            lambda x: x.astype(cdt)
-            if jnp.issubdtype(x.dtype, jnp.floating) else x, params)
-
     def _prep_params(self, params):
         """Compute-dtype cast (+ unstack + int8 quantization when
         enabled) as ONE jitted program.  The transforms are idempotent
@@ -214,17 +206,11 @@ class ContinuousBatchingEngine:
         if params is getattr(self, "_prep_src", None):
             return self._prep_out
         if not hasattr(self, "_jit_prep"):
-            from orion_tpu.models.transformer import \
-                maybe_unstack_for_decode
+            from orion_tpu.models.transformer import prep_decode_params
 
             def prep(p):
-                p = self._compute_cast(p)
-                p = maybe_unstack_for_decode(p, self.mc)
-                if self._quantize_weights:
-                    from orion_tpu.ops.quant import quantize_params_int8
-
-                    p = quantize_params_int8(p)
-                return p
+                return prep_decode_params(p, self.mc,
+                                          self._quantize_weights)
 
             # With a mesh the prepared decode tree lands directly in the
             # tensor-sharded layout — this IS the train→rollout reshard

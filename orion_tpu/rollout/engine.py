@@ -84,8 +84,26 @@ class RolloutEngine:
             self._decode_cfg = dataclasses.replace(
                 self._decode_cfg, quantize_dense=True)
             self._decode_model = type(self._decode_model)(self._decode_cfg)
+        if cfg.speculative_k > 0:
+            if cfg.temperature != 0.0:
+                raise ValueError(
+                    "speculative_k > 0 requires temperature=0.0 (greedy "
+                    "acceptance; exact stochastic speculative sampling "
+                    "is not implemented)")
+            if cfg.paged:
+                raise ValueError(
+                    "speculative_k > 0 requires the dense cache "
+                    "(paged=False): the draft chunk writes k+1 "
+                    "positions past the current length, outside a "
+                    "paged reservation")
+            if cfg.repetition_penalty != 1.0 or cfg.min_new_tokens:
+                raise ValueError(
+                    "speculative_k > 0 does not compose with "
+                    "repetition_penalty / min_new_tokens yet")
         self._generate_jit = jax.jit(
             self._generate, static_argnames=("max_new_tokens",))
+        self._generate_spec_jit = jax.jit(
+            self._generate_spec, static_argnames=("max_new_tokens",))
 
     # -- weight hot-reload channel (trainer → rollout) ------------------
     def load_weights(self, params: Any) -> None:
@@ -103,8 +121,15 @@ class RolloutEngine:
         if params is None:
             raise ValueError("no weights loaded: call load_weights() first")
         T = int(max_new_tokens or self.cfg.max_new_tokens)
-        out = self._generate_jit(params, prompt_ids, prompt_lens, rng,
-                                 max_new_tokens=T)
+        if self.cfg.speculative_k > 0:
+            out = self._generate_spec_jit(params, prompt_ids, prompt_lens,
+                                          rng, max_new_tokens=T)
+            # diagnostic: verify-forward count (device scalar; fetch
+            # lazily — bench/AB scripts read it, trainers ignore it)
+            self.last_spec_steps = out.pop("spec_steps")
+        else:
+            out = self._generate_jit(params, prompt_ids, prompt_lens, rng,
+                                     max_new_tokens=T)
         return GenerationResult(**out)
 
     def _generate(self, params, prompt_ids, prompt_lens, rng,
@@ -117,23 +142,16 @@ class RolloutEngine:
         sample = partial(sample_tokens, temperature=cfg.temperature,
                          top_k=cfg.top_k, top_p=cfg.top_p)
 
-        # Engine weights are read once per decode step; cast the f32
-        # master params to the compute dtype OUTSIDE the decode loop so
-        # every step reads 2 bytes/param instead of 4 + a per-op cast
-        # (flax's per-layer promote_dtype is NOT hoisted out of
-        # while_loop by XLA — measured ~2x decode bandwidth).
-        cdt = jnp.dtype(self.model_cfg.dtype)
-        if cdt != jnp.dtype(self.model_cfg.param_dtype):
-            params = jax.tree.map(
-                lambda x: x.astype(cdt)
-                if jnp.issubdtype(x.dtype, jnp.floating) else x, params)
-        from orion_tpu.models.transformer import maybe_unstack_for_decode
+        # Engine weights are read once per decode step; the shared prep
+        # (compute-dtype cast OUTSIDE the decode loop — every step then
+        # reads 2 bytes/param instead of 4 + a per-op cast, flax's
+        # per-layer promote_dtype is NOT hoisted out of while_loop by
+        # XLA, measured ~2x decode bandwidth — plus unstack + optional
+        # int8) lives in one place for all engine paths.
+        from orion_tpu.models.transformer import prep_decode_params
 
-        params = maybe_unstack_for_decode(params, self.model_cfg)
-        if cfg.quantize_weights:
-            from orion_tpu.ops.quant import quantize_params_int8
-
-            params = quantize_params_int8(params)
+        params = prep_decode_params(params, self.model_cfg,
+                                    cfg.quantize_weights)
 
         if cfg.paged:
             from orion_tpu.ops.paged_kv import init_paged_cache
@@ -239,4 +257,162 @@ class RolloutEngine:
             policy_logprobs=plogps,
             prompt_lens=prompt_lens,
             total_lens=prompt_lens + comp_len,
+        )
+
+    def _generate_spec(self, params, prompt_ids, prompt_lens, rng,
+                       max_new_tokens: int):
+        """Greedy decode with n-gram (prompt-lookup) speculative
+        drafting: each verify step drafts ``speculative_k`` tokens by
+        matching the trailing ``spec_ngram``-gram against earlier
+        sequence content, runs ONE chunked forward over the k+1
+        candidate positions, and accepts the longest prefix agreeing
+        with the model's own argmax — decode reads the full weight set
+        once per verify step instead of once per token, so the speedup
+        is ≈ mean tokens emitted per step on an HBM-bound decode.
+
+        Correctness invariants (why this is EXACT greedy):
+          - acceptance compares drafts against argmax of the SAME
+            logits plain greedy would produce, so emitted tokens are
+            bit-identical to the sequential path regardless of draft
+            quality (a bad draft only costs speed);
+          - the cache stays consistent because each chunk writes k+1
+            consecutive positions starting exactly at the first
+            stale position (the previous step's bonus-token slot), so
+            rejected-draft KV is always overwritten before any query
+            position can attend it (queries at position p only attend
+            keys <= p, and the chunk writes before attending — the
+            same property chunked prefill relies on);
+          - the cache is allocated k positions past P+T because the
+            final step's chunk may probe past the budget; those writes
+            land in the slack and are never attended.
+        """
+        cfg = self.cfg
+        gamma = int(cfg.speculative_k)
+        n = int(cfg.spec_ngram)
+        B, P = prompt_ids.shape
+        T = max_new_tokens
+        eos = self.eos_token_id
+        pad = self.pad_token_id
+
+        from orion_tpu.models.transformer import prep_decode_params
+
+        params = prep_decode_params(params, self.model_cfg,
+                                    cfg.quantize_weights)
+
+        from orion_tpu.ops.sampling import is_stop_token
+
+        cap = P + T + gamma  # chunk slack past the budget
+        cache = init_cache(self._decode_cfg, B, cap,
+                           dtype=jnp.dtype(self._decode_cfg.dtype),
+                           quantized=cfg.quantize_kv)
+        positions = jnp.broadcast_to(jnp.arange(P, dtype=jnp.int32), (B, P))
+        with jax.named_scope("prefill"):
+            logits, cache = self._decode_model.apply(
+                {"params": params}, prompt_ids, positions, cache,
+                logits_positions=(prompt_lens - 1)[:, None])
+        lsm0 = jax.nn.log_softmax(logits[:, 0].astype(jnp.float32), axis=-1)
+        tok0 = jnp.argmax(lsm0, axis=-1).astype(jnp.int32)
+        lp0 = jnp.take_along_axis(lsm0, tok0[:, None], axis=-1)[:, 0]
+
+        bidx = jnp.arange(B)
+        tokens = jnp.full((B, T), pad, jnp.int32).at[:, 0].set(tok0)
+        logps = jnp.zeros((B, T), jnp.float32).at[:, 0].set(lp0)
+        done = is_stop_token(tok0, eos, cfg.stop_token_ids) | (T <= 1)
+        comp_len = jnp.ones((B,), jnp.int32)
+        # full-sequence buffer (draft source): prompt + generated
+        seq = jnp.full((B, cap), pad, jnp.int32)
+        seq = jax.lax.dynamic_update_slice(seq, prompt_ids, (0, 0))
+        seq = seq.at[bidx, prompt_lens].set(tok0)
+        ln = prompt_lens + 1            # total content length
+        cur = tok0                      # last token, KV not yet written
+
+        n_win = cap - n - gamma + 1     # draftable window starts
+        w_idx = jnp.arange(n_win)
+
+        def draft_fn(seq, ln):
+            # trailing n-gram of each row
+            tgt = jnp.stack(
+                [jnp.take_along_axis(seq, (ln - n + i)[:, None],
+                                     axis=1)[:, 0] for i in range(n)],
+                axis=1)                                     # [B, n]
+            eq = jnp.ones((B, n_win), bool)
+            for i in range(n):
+                eq &= seq[:, i: i + n_win] == tgt[:, i: i + 1]
+            # latest PRIOR occurrence: window start s with s+n < ln
+            valid = eq & (w_idx[None, :] + n < ln[:, None])
+            score = jnp.where(valid, w_idx[None, :], -1)
+            s = jnp.max(score, axis=1)                      # [B], -1 = none
+            s0 = jnp.maximum(s, 0)
+            drafts = jnp.stack(
+                [jnp.take_along_axis(seq, (s0 + n + i)[:, None],
+                                     axis=1)[:, 0] for i in range(gamma)],
+                axis=1)                                     # [B, gamma]
+            # no match -> draft pads; they are verified like any draft
+            return jnp.where((s >= 0)[:, None], drafts, pad)
+
+        def cond(c):
+            it, done = c[0], c[4]
+            return (it < T) & ~jnp.all(done)
+
+        def body(c):
+            it, seq, ln, cur, done, comp_len, tokens, logps, cache = c
+            drafts = draft_fn(seq, ln)
+            chunk = jnp.concatenate([cur[:, None], drafts], axis=1)
+            # done rows idle in place: ln is frozen (n_emit 0), so
+            # their chunk rewrites the same slack slots, never attended
+            pos = (ln - 1)[:, None] + jnp.arange(gamma + 1,
+                                                 dtype=jnp.int32)
+            step_logits, cache = self._decode_model.apply(
+                {"params": params}, chunk, pos, cache)
+            lsm = jax.nn.log_softmax(step_logits.astype(jnp.float32),
+                                     axis=-1)               # [B, g+1, V]
+            g = jnp.argmax(lsm, axis=-1).astype(jnp.int32)  # [B, g+1]
+            lp_g = jnp.take_along_axis(lsm, g[..., None],
+                                       axis=-1)[..., 0]     # [B, g+1]
+            # longest accepted prefix of the drafts
+            acc = jnp.cumprod(
+                (drafts == g[:, :gamma]).astype(jnp.int32), axis=1)
+            m = jnp.sum(acc, axis=1)                        # [B] 0..gamma
+            stopped = jnp.zeros((B,), bool)
+            n_emit = jnp.zeros((B,), jnp.int32)
+            last_tok = cur
+            for j in range(gamma + 1):
+                e_j = g[:, j]
+                valid = (~done) & (j <= m) & ~stopped & (comp_len + j < T)
+                wi = jnp.where(valid, comp_len + j, T)
+                tokens = tokens.at[bidx, wi].set(e_j, mode="drop")
+                logps = logps.at[bidx, wi].set(lp_g[:, j], mode="drop")
+                si = jnp.where(valid, ln + j, cap)
+                seq = seq.at[bidx, si].set(e_j, mode="drop")
+                stopped = stopped | (valid & is_stop_token(
+                    e_j, eos, cfg.stop_token_ids))
+                n_emit = n_emit + valid
+                last_tok = jnp.where(valid, e_j, last_tok)
+            comp_len = comp_len + n_emit
+            ln = ln + n_emit
+            done = done | stopped | (comp_len >= T)
+            return (it + 1, seq, ln, last_tok, done, comp_len, tokens,
+                    logps, cache)
+
+        init = (jnp.int32(1), seq, ln, cur, done, comp_len, tokens, logps,
+                cache)
+        with jax.named_scope("spec_decode"):
+            it, seq, ln, cur, done, comp_len, tokens, logps, cache = \
+                jax.lax.while_loop(cond, body, init)
+
+        mask = (jnp.arange(T)[None, :] < comp_len[:, None]).astype(
+            jnp.float32)
+        sequences = pack_sequences(prompt_ids, prompt_lens, tokens)
+        return dict(
+            sequences=sequences,
+            completions=tokens,
+            completion_mask=mask,
+            completion_lens=comp_len,
+            logprobs=logps,
+            # untransformed greedy: behavior logprob == raw policy
+            # logprob (the engines' convention, see sample_tokens)
+            policy_logprobs=logps,
+            prompt_lens=prompt_lens,
+            total_lens=prompt_lens + comp_len,
+            spec_steps=it - 1,
         )

@@ -1,0 +1,112 @@
+"""N-gram speculative decoding (simple engine, greedy prototype): the
+drafted-and-verified path must be OUTPUT-IDENTICAL to plain greedy —
+acceptance compares drafts against the same argmax plain greedy would
+take, so draft quality can only affect speed, never content."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from orion_tpu.config import ModelConfig, RolloutConfig
+from orion_tpu.models import Transformer, init_params
+from orion_tpu.rollout import RolloutEngine
+
+
+def _engines(eos=None, max_new=16, k=4, ngram=2, **kw):
+    cfg = ModelConfig.tiny(dtype="float32")
+    model = Transformer(cfg)
+    params = init_params(model, jax.random.key(0), cfg)
+    plain = RolloutEngine(model, cfg,
+                          RolloutConfig(max_new_tokens=max_new,
+                                        temperature=0.0, **kw),
+                          eos_token_id=eos)
+    spec = RolloutEngine(model, cfg,
+                         RolloutConfig(max_new_tokens=max_new,
+                                       temperature=0.0, speculative_k=k,
+                                       spec_ngram=ngram, **kw),
+                         eos_token_id=eos)
+    plain.load_weights(params)
+    spec.load_weights(params)
+    return cfg, plain, spec
+
+
+def _batch(cfg, B=4, P=12, seed=0):
+    rng = np.random.RandomState(seed)
+    lens = rng.randint(2, P + 1, size=B).astype(np.int32)
+    ids = np.zeros((B, P), np.int32)
+    for i in range(B):
+        ids[i, : lens[i]] = rng.randint(4, cfg.vocab_size, lens[i])
+    return jnp.asarray(ids), jnp.asarray(lens)
+
+
+@pytest.mark.parametrize("eos", [None, 5])
+@pytest.mark.parametrize("k", [1, 4])
+def test_speculative_matches_plain_greedy(eos, k):
+    cfg, plain, spec = _engines(eos=eos, k=k)
+    ids, lens = _batch(cfg)
+    a = plain.generate(ids, lens, jax.random.key(1))
+    b = spec.generate(ids, lens, jax.random.key(1))
+    np.testing.assert_array_equal(np.asarray(a.completions),
+                                  np.asarray(b.completions))
+    np.testing.assert_array_equal(np.asarray(a.completion_lens),
+                                  np.asarray(b.completion_lens))
+    np.testing.assert_array_equal(np.asarray(a.sequences),
+                                  np.asarray(b.sequences))
+    np.testing.assert_allclose(np.asarray(a.logprobs),
+                               np.asarray(b.logprobs), rtol=1e-5,
+                               atol=1e-5)
+    steps = int(np.asarray(spec.last_spec_steps))
+    assert 1 <= steps <= 16
+
+
+def test_speculative_accelerates_on_cyclic_output():
+    """Tiny random transformers fall into greedy cycles; once the
+    output is periodic the n-gram draft predicts it perfectly and each
+    verify step emits k+1 tokens.  Find a cycling seed and assert the
+    verify-step count beats one-token-per-step."""
+    cfg, plain, spec = _engines(max_new=32, k=4)
+    ids, lens = _batch(cfg, B=8, seed=3)
+    out = spec.generate(ids, lens, jax.random.key(2))
+    comp = np.asarray(out.completions)
+    # sanity: with eos=None every row emits the full budget
+    assert int(np.asarray(out.completion_lens).min()) == 32
+    steps = int(np.asarray(spec.last_spec_steps))
+    has_cycle = any(
+        any(tuple(comp[i, t:t + 2]) == tuple(comp[i, t + 2:t + 4])
+            for t in range(0, 24))
+        for i in range(comp.shape[0]))
+    if has_cycle:
+        assert steps < 32, steps  # strictly beats sequential decode
+    # and never exceeds the sequential bound
+    assert steps <= 32
+
+
+def test_speculative_rejects_bad_configs():
+    cfg = ModelConfig.tiny(dtype="float32")
+    model = Transformer(cfg)
+    with pytest.raises(ValueError, match="temperature"):
+        RolloutEngine(model, cfg, RolloutConfig(temperature=1.0,
+                                                speculative_k=4))
+    with pytest.raises(ValueError, match="dense cache"):
+        RolloutEngine(model, cfg, RolloutConfig(temperature=0.0,
+                                                speculative_k=4,
+                                                paged=True))
+    with pytest.raises(ValueError, match="compose"):
+        RolloutEngine(model, cfg, RolloutConfig(temperature=0.0,
+                                                speculative_k=4,
+                                                repetition_penalty=1.2))
+
+
+def test_speculative_stop_token_ids():
+    """Stop ids must terminate inside an accepted chunk exactly as in
+    sequential decode (tokens after the stop are not emitted)."""
+    cfg, plain, spec = _engines(eos=None, max_new=12, k=4,
+                                stop_token_ids=(9, 11))
+    ids, lens = _batch(cfg, B=6, seed=7)
+    a = plain.generate(ids, lens, jax.random.key(1))
+    b = spec.generate(ids, lens, jax.random.key(1))
+    np.testing.assert_array_equal(np.asarray(a.completions),
+                                  np.asarray(b.completions))
+    np.testing.assert_array_equal(np.asarray(a.completion_lens),
+                                  np.asarray(b.completion_lens))
