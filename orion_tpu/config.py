@@ -250,14 +250,17 @@ class RolloutConfig:
     # training graph is never quantized.
     quantize_weights: bool = False
     quantize_kv: bool = False
-    # Speculative decoding (simple engine, greedy): draft speculative_k
-    # tokens per step by prompt-lookup (match the trailing
-    # spec_ngram-gram against earlier sequence content) and verify all
-    # k+1 positions in ONE chunked forward — decode is HBM-bound, so a
-    # step that emits m+1 tokens reads the weights once instead of m+1
-    # times.  0 disables.  Prototype scope: temperature=0 (greedy
-    # acceptance is exact, output is bit-identical to plain greedy),
-    # dense cache, no repetition penalty / min_new_tokens.
+    # Speculative decoding (simple engine): draft speculative_k tokens
+    # per step by prompt-lookup (match the trailing spec_ngram-gram
+    # against earlier sequence content) and verify all k+1 positions
+    # in ONE chunked forward — decode is HBM-bound, so a step that
+    # emits m+1 tokens reads the weights once instead of m+1 times.
+    # 0 disables.  Exact in both modes: greedy output is bit-identical
+    # to sequential decode; temperature>0 uses delta-draft speculative
+    # sampling whose emitted-token marginal is exactly the tempered
+    # sampling distribution (behavior logprobs stay correct for the
+    # async importance ratio).  Scope: dense cache, no repetition
+    # penalty / min_new_tokens.
     speculative_k: int = 0
     spec_ngram: int = 2
     # Shared-prefix group admission (continuous engine): when a trainer

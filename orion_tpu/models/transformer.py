@@ -462,9 +462,6 @@ def prep_decode_params(params: Any, cfg: ModelConfig,
     quantization.  Each transform is idempotent, so pre-processed
     trees pass through unchanged.  A prep-order change edits exactly
     one place."""
-    import jax
-    import jax.numpy as jnp
-
     cdt = jnp.dtype(cfg.dtype)
     if cdt != jnp.dtype(cfg.param_dtype):
         params = jax.tree.map(

@@ -94,6 +94,22 @@ def is_stop_token(tokens: jnp.ndarray, eos_id,
     return done
 
 
+def transformed_logits(logits: jnp.ndarray, temperature: float,
+                       top_k: int = 0, top_p: float = 1.0) -> jnp.ndarray:
+    """The sampling-distribution transform pipeline of sample_tokens
+    (temperature → top-k → top-p), factored out for callers that need
+    the full transformed distribution rather than one draw — the
+    speculative-sampling acceptance test evaluates p(token) under
+    EXACTLY the distribution sample_tokens would draw from.
+    temperature must be > 0 (greedy has no sampling distribution)."""
+    logits = logits.astype(jnp.float32) / temperature
+    if top_k > 0:
+        logits = _mask_top_k(logits, top_k)
+    if top_p < 1.0:
+        logits = _mask_top_p(logits, top_p)
+    return logits
+
+
 def sample_tokens(rng: jax.Array, logits: jnp.ndarray, temperature: float,
                   top_k: int = 0, top_p: float = 1.0,
                   seen: Optional[jnp.ndarray] = None,

@@ -169,8 +169,8 @@ class AsyncOrchestrator:
 
         The f32 master tree is cast to the engines' compute dtype ON
         THE TRAIN MESH first (VERDICT r4 weak #4): the engines cast
-        before every decode anyway (``_compute_cast`` runs first in
-        ``_prep_params``), so shipping f32 across the group boundary
+        before every decode anyway (the cast runs first in
+        ``prep_decode_params``), so shipping f32 across the group boundary
         doubled the sync bytes for nothing — 32 GB/update at the 8B
         flagship config, 16 GB after this cast.  Numerics are
         unchanged: int8 engine quantization already started from the

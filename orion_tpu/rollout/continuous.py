@@ -61,6 +61,12 @@ class ContinuousBatchingEngine:
         self.mc = model_cfg
         self.cfg = cfg
         cfg.check_stop_ids(model_cfg.vocab_size, eos_token_id)
+        if cfg.speculative_k > 0:
+            raise ValueError(
+                "speculative_k is a simple-engine (dense-cache) "
+                "feature; the continuous engine's paged reservations "
+                "have no slack for draft chunks yet — use "
+                "engine='simple' for speculative decoding")
         self.eos = eos_token_id
         self.pad = pad_token_id
         self.segment_len = (cfg.segment_len if segment_len is None

@@ -85,11 +85,6 @@ class RolloutEngine:
                 self._decode_cfg, quantize_dense=True)
             self._decode_model = type(self._decode_model)(self._decode_cfg)
         if cfg.speculative_k > 0:
-            if cfg.temperature != 0.0:
-                raise ValueError(
-                    "speculative_k > 0 requires temperature=0.0 (greedy "
-                    "acceptance; exact stochastic speculative sampling "
-                    "is not implemented)")
             if cfg.paged:
                 raise ValueError(
                     "speculative_k > 0 requires the dense cache "
@@ -261,30 +256,39 @@ class RolloutEngine:
 
     def _generate_spec(self, params, prompt_ids, prompt_lens, rng,
                        max_new_tokens: int):
-        """Greedy decode with n-gram (prompt-lookup) speculative
-        drafting: each verify step drafts ``speculative_k`` tokens by
-        matching the trailing ``spec_ngram``-gram against earlier
-        sequence content, runs ONE chunked forward over the k+1
-        candidate positions, and accepts the longest prefix agreeing
-        with the model's own argmax — decode reads the full weight set
-        once per verify step instead of once per token, so the speedup
-        is ≈ mean tokens emitted per step on an HBM-bound decode.
+        """Decode with n-gram (prompt-lookup) speculative drafting:
+        each verify step drafts ``speculative_k`` tokens by matching
+        the trailing ``spec_ngram``-gram against earlier sequence
+        content, runs ONE chunked forward over the k+1 candidate
+        positions, and accepts a prefix — decode reads the full weight
+        set once per verify step instead of once per token, so the
+        speedup is ≈ mean tokens emitted per step on an HBM-bound
+        decode.
 
-        Correctness invariants (why this is EXACT greedy):
-          - acceptance compares drafts against argmax of the SAME
-            logits plain greedy would produce, so emitted tokens are
-            bit-identical to the sequential path regardless of draft
+        Acceptance is EXACT in both modes:
+          - temperature=0: accept drafts agreeing with argmax of the
+            SAME logits plain greedy would produce — output is
+            bit-identical to sequential greedy regardless of draft
             quality (a bad draft only costs speed);
-          - the cache stays consistent because each chunk writes k+1
-            consecutive positions starting exactly at the first
-            stale position (the previous step's bonus-token slot), so
-            rejected-draft KV is always overwritten before any query
-            position can attend it (queries at position p only attend
-            keys <= p, and the chunk writes before attending — the
-            same property chunked prefill relies on);
-          - the cache is allocated k positions past P+T because the
-            final step's chunk may probe past the budget; those writes
-            land in the slack and are never attended.
+          - temperature>0: delta-draft speculative sampling (the
+            deterministic-draft case of Leviathan et al.): accept
+            draft x with probability p(x) under the tempered/truncated
+            sampling distribution; on rejection resample from p with x
+            excluded.  The emitted token's MARGINAL distribution is
+            exactly p, so ``logprobs`` (= log p(token), the behavior
+            logprob the async importance ratio needs) stays correct —
+            the token stream differs from the sequential path only in
+            which RNG draws produced it, not in distribution.
+
+        Cache consistency (both modes): each chunk writes k+1
+        consecutive positions starting exactly at the first stale
+        position (the previous step's bonus-token slot), so rejected-
+        draft KV is always overwritten before any query position can
+        attend it (queries at position p only attend keys <= p, and
+        the chunk writes before attending — the same property chunked
+        prefill relies on).  The cache is allocated k positions past
+        P+T because the final step's chunk may probe past the budget;
+        those writes land in the slack and are never attended.
         """
         cfg = self.cfg
         gamma = int(cfg.speculative_k)
@@ -299,7 +303,10 @@ class RolloutEngine:
         params = prep_decode_params(params, self.model_cfg,
                                     cfg.quantize_weights)
 
-        from orion_tpu.ops.sampling import is_stop_token
+        from orion_tpu.ops.sampling import (is_stop_token, sample_tokens,
+                                            transformed_logits)
+
+        stochastic = cfg.temperature != 0.0
 
         cap = P + T + gamma  # chunk slack past the budget
         cache = init_cache(self._decode_cfg, B, cap,
@@ -310,13 +317,17 @@ class RolloutEngine:
             logits, cache = self._decode_model.apply(
                 {"params": params}, prompt_ids, positions, cache,
                 logits_positions=(prompt_lens - 1)[:, None])
-        lsm0 = jax.nn.log_softmax(logits[:, 0].astype(jnp.float32), axis=-1)
-        tok0 = jnp.argmax(lsm0, axis=-1).astype(jnp.int32)
-        lp0 = jnp.take_along_axis(lsm0, tok0[:, None], axis=-1)[:, 0]
+        rng, sub = jax.random.split(rng)
+        # first token: one ordinary draw from the sampling distribution
+        # (greedy argmax at temperature 0) — drafting starts after it
+        tok0, lp0, plp0 = sample_tokens(
+            sub, logits[:, 0], temperature=cfg.temperature,
+            top_k=cfg.top_k, top_p=cfg.top_p)
 
         bidx = jnp.arange(B)
         tokens = jnp.full((B, T), pad, jnp.int32).at[:, 0].set(tok0)
         logps = jnp.zeros((B, T), jnp.float32).at[:, 0].set(lp0)
+        plogps = jnp.zeros((B, T), jnp.float32).at[:, 0].set(plp0)
         done = is_stop_token(tok0, eos, cfg.stop_token_ids) | (T <= 1)
         comp_len = jnp.ones((B,), jnp.int32)
         # full-sequence buffer (draft source): prompt + generated
@@ -351,11 +362,12 @@ class RolloutEngine:
             return jnp.where((s >= 0)[:, None], drafts, pad)
 
         def cond(c):
-            it, done = c[0], c[4]
+            it, done = c[0], c[5]
             return (it < T) & ~jnp.all(done)
 
         def body(c):
-            it, seq, ln, cur, done, comp_len, tokens, logps, cache = c
+            (it, rng, seq, ln, cur, done, comp_len, tokens, logps,
+             plogps, cache) = c
             drafts = draft_fn(seq, ln)
             chunk = jnp.concatenate([cur[:, None], drafts], axis=1)
             # done rows idle in place: ln is frozen (n_emit 0), so
@@ -364,24 +376,58 @@ class RolloutEngine:
                                                  dtype=jnp.int32)
             step_logits, cache = self._decode_model.apply(
                 {"params": params}, chunk, pos, cache)
-            lsm = jax.nn.log_softmax(step_logits.astype(jnp.float32),
-                                     axis=-1)               # [B, g+1, V]
-            g = jnp.argmax(lsm, axis=-1).astype(jnp.int32)  # [B, g+1]
-            lp_g = jnp.take_along_axis(lsm, g[..., None],
+            raw_lsm = jax.nn.log_softmax(
+                step_logits.astype(jnp.float32), axis=-1)   # [B, g+1, V]
+            if not stochastic:
+                # greedy acceptance: emitted = the model's own argmax
+                p_lsm = raw_lsm
+                e = jnp.argmax(raw_lsm, axis=-1).astype(jnp.int32)
+                acc = jnp.cumprod(
+                    (drafts == e[:, :gamma]).astype(jnp.int32), axis=1)
+                m = jnp.sum(acc, axis=1)                    # [B] 0..gamma
+            else:
+                # delta-draft speculative sampling: accept draft x
+                # w.p. p(x); on rejection resample from p excluding x;
+                # after a full accept, one ordinary bonus draw.  The
+                # marginal of every emitted token is exactly p.
+                t_logits = transformed_logits(
+                    step_logits, cfg.temperature, cfg.top_k, cfg.top_p)
+                p_lsm = jax.nn.log_softmax(t_logits, axis=-1)
+                rng, k_u, k_cat = jax.random.split(rng, 3)
+                u = jax.random.uniform(k_u, (B, gamma))
+                p_draft = jnp.exp(jnp.take_along_axis(
+                    p_lsm[:, :gamma], drafts[..., None],
+                    axis=-1)[..., 0])                       # [B, gamma]
+                acc = jnp.cumprod((u < p_draft).astype(jnp.int32),
+                                  axis=1)
+                m = jnp.sum(acc, axis=1)                    # [B] 0..gamma
+                # per-position correction draws: position j < gamma →
+                # residual (draft excluded); position gamma → bonus
+                excl = jnp.full((B, gamma + 1, t_logits.shape[-1]),
+                                False).at[
+                    bidx[:, None], jnp.arange(gamma)[None, :],
+                    drafts].set(True)
+                resampled = jax.random.categorical(
+                    k_cat, jnp.where(excl, jnp.float32(-1e10), t_logits),
+                    axis=-1).astype(jnp.int32)              # [B, g+1]
+                e = jnp.where(
+                    jnp.arange(gamma + 1)[None, :] < m[:, None],
+                    jnp.pad(drafts, ((0, 0), (0, 1))), resampled)
+            lp_e = jnp.take_along_axis(p_lsm, e[..., None],
                                        axis=-1)[..., 0]     # [B, g+1]
-            # longest accepted prefix of the drafts
-            acc = jnp.cumprod(
-                (drafts == g[:, :gamma]).astype(jnp.int32), axis=1)
-            m = jnp.sum(acc, axis=1)                        # [B] 0..gamma
+            plp_e = jnp.take_along_axis(raw_lsm, e[..., None],
+                                        axis=-1)[..., 0]
             stopped = jnp.zeros((B,), bool)
             n_emit = jnp.zeros((B,), jnp.int32)
             last_tok = cur
             for j in range(gamma + 1):
-                e_j = g[:, j]
+                e_j = e[:, j]
                 valid = (~done) & (j <= m) & ~stopped & (comp_len + j < T)
                 wi = jnp.where(valid, comp_len + j, T)
                 tokens = tokens.at[bidx, wi].set(e_j, mode="drop")
-                logps = logps.at[bidx, wi].set(lp_g[:, j], mode="drop")
+                logps = logps.at[bidx, wi].set(lp_e[:, j], mode="drop")
+                plogps = plogps.at[bidx, wi].set(plp_e[:, j],
+                                                 mode="drop")
                 si = jnp.where(valid, ln + j, cap)
                 seq = seq.at[bidx, si].set(e_j, mode="drop")
                 stopped = stopped | (valid & is_stop_token(
@@ -391,14 +437,14 @@ class RolloutEngine:
             comp_len = comp_len + n_emit
             ln = ln + n_emit
             done = done | stopped | (comp_len >= T)
-            return (it + 1, seq, ln, last_tok, done, comp_len, tokens,
-                    logps, cache)
+            return (it + 1, rng, seq, ln, last_tok, done, comp_len,
+                    tokens, logps, plogps, cache)
 
-        init = (jnp.int32(1), seq, ln, cur, done, comp_len, tokens, logps,
-                cache)
+        init = (jnp.int32(1), rng, seq, ln, cur, done, comp_len, tokens,
+                logps, plogps, cache)
         with jax.named_scope("spec_decode"):
-            it, seq, ln, cur, done, comp_len, tokens, logps, cache = \
-                jax.lax.while_loop(cond, body, init)
+            (it, rng, seq, ln, cur, done, comp_len, tokens, logps,
+             plogps, cache) = jax.lax.while_loop(cond, body, init)
 
         mask = (jnp.arange(T)[None, :] < comp_len[:, None]).astype(
             jnp.float32)
@@ -409,9 +455,7 @@ class RolloutEngine:
             completion_mask=mask,
             completion_lens=comp_len,
             logprobs=logps,
-            # untransformed greedy: behavior logprob == raw policy
-            # logprob (the engines' convention, see sample_tokens)
-            policy_logprobs=logps,
+            policy_logprobs=plogps,
             prompt_lens=prompt_lens,
             total_lens=prompt_lens + comp_len,
             spec_steps=it - 1,
