@@ -119,6 +119,13 @@ def _preset(backend: str):
         cfg.minibatch_size = 4
         cfg.num_epochs = 1
     cfg.rollout.temperature = 1.0
+    # Staged on-chip A/B (r5): ORION_BENCH_SPEC=k turns on n-gram
+    # speculative decoding for the rollout (exact in both greedy and
+    # stochastic modes — see PERF.md).  Off by default until the
+    # acceptance rate is measured on-chip at the bench shapes.
+    spec = int(os.environ.get("ORION_BENCH_SPEC", "0"))
+    if spec:
+        cfg.rollout.speculative_k = spec
     return name, cfg
 
 
