@@ -56,10 +56,18 @@ from orion_tpu.ops.pallas import NEG_INF, interpret_mode
 
 
 def _pick_block(n: int, preferred: int) -> int:
-    for c in (preferred, 512, 256, 128, 64, 32, 16, 8, 4, 2, 1):
+    # Mosaic requires the second-minor block dim to be a multiple of 8
+    # OR equal to the full array dim.  A dim that fits in one block is
+    # therefore always legal as-is — and any sub-8 divisor is NOT
+    # (found on-chip r5: the speculative verify chunk runs Lq=k+1=5
+    # over an Lk=388 cache; the old divisor scan chose bkv=4 and
+    # Mosaic refused to lower — invisible to CPU interpret mode).
+    if n <= preferred:
+        return n
+    for c in (preferred, 512, 256, 128, 64, 32, 16, 8):
         if c <= preferred and n % c == 0:
             return c
-    return 1
+    return n  # no legal tile ≤ preferred: one full-dim block
 
 
 def _block_extents(q_positions, kv_positions, bq, bkv, nkv=None):

@@ -95,6 +95,16 @@ class RolloutEngine:
                 raise ValueError(
                     "speculative_k > 0 does not compose with "
                     "repetition_penalty / min_new_tokens yet")
+            # Verify chunks are k+1 queries wide; at that width the
+            # flash kernel's sub-8-row MXU tiles lose to the XLA
+            # einsum (measured on-chip r5: chunk cost 2.5x -> 1.55x a
+            # plain decode step).  A separate twin pins the reference
+            # path for the CHUNK apply only — prefill (Lq = P) stays
+            # on the main twin so it keeps the flash kernel; both
+            # twins share the same params.
+            self._spec_verify_model = type(self._decode_model)(
+                dataclasses.replace(self._decode_cfg,
+                                    attention_impl="reference"))
         self._generate_jit = jax.jit(
             self._generate, static_argnames=("max_new_tokens",))
         self._generate_spec_jit = jax.jit(
@@ -374,7 +384,7 @@ class RolloutEngine:
             # their chunk rewrites the same slack slots, never attended
             pos = (ln - 1)[:, None] + jnp.arange(gamma + 1,
                                                  dtype=jnp.int32)
-            step_logits, cache = self._decode_model.apply(
+            step_logits, cache = self._spec_verify_model.apply(
                 {"params": params}, chunk, pos, cache)
             raw_lsm = jax.nn.log_softmax(
                 step_logits.astype(jnp.float32), axis=-1)   # [B, g+1, V]

@@ -76,6 +76,28 @@ def instrument_prefill(eng):
     return acc
 
 
+def build_model():
+    """GP_MODEL=tiny (default, CPU harness) or pythia1b (on-chip: the
+    r5 TPU run showed the tiny model measures tunnel-RTT-per-dispatch,
+    not prefill compute — both arms' prefill programs finish in
+    microseconds and the blocked fetch costs ~112 ms either way.  The
+    compute-bound comparison needs prefill FLOPs >> RTT, i.e. a real
+    model)."""
+    from orion_tpu.config import ModelConfig
+
+    name = os.environ.get("GP_MODEL", "tiny")
+    if name == "pythia1b":
+        mc = ModelConfig.pythia_1b()
+        mc.dtype = "bfloat16"
+    else:
+        mc = ModelConfig.tiny(vocab_size=1024, hidden_size=128,
+                              intermediate_size=512, num_layers=2,
+                              num_heads=4, num_kv_heads=4,
+                              dtype="float32")
+    mc.max_seq_len = max(mc.max_seq_len, P + T)
+    return mc
+
+
 def run(eng, params, prompts, lens, tag):
     acc = instrument_prefill(eng)
     # warm-up compiles, then timed reps
@@ -92,20 +114,17 @@ def run(eng, params, prompts, lens, tag):
         pre.append(acc["s"])
         assert out.completions.shape[0] == B * K
     best, best_pre = min(times), min(pre)
+    calls = acc["calls"] // (REPS + 1)  # per-generate_batch average
     print(f"  {tag:24s} total {best*1e3:8.1f} ms   prefill "
-          f"{best_pre*1e3:8.1f} ms  ({B}x{K} prompts, P={P}, T={T})",
-          flush=True)
+          f"{best_pre*1e3:8.1f} ms / {calls} call(s)  "
+          f"({B}x{K} prompts, P={P}, T={T})", flush=True)
     return best, best_pre
 
 
 def main():
-    from orion_tpu.config import ModelConfig
     from orion_tpu.models import Transformer, init_params
 
-    mc = ModelConfig.tiny(vocab_size=1024, hidden_size=128,
-                          intermediate_size=512, num_layers=2,
-                          num_heads=4, num_kv_heads=4, dtype="float32")
-    mc.max_seq_len = P + T
+    mc = build_model()
     model = Transformer(mc)
     params = init_params(model, jax.random.key(0), mc)
     rs = np.random.RandomState(0)

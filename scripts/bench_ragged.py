@@ -38,6 +38,7 @@ N_REQ = int(os.environ.get("RAGGED_N", "64"))
 B = 32           # simple-engine batch size == continuous slot count
 P = 256
 T = 128
+SEG = int(os.environ.get("RAGGED_SEG", "16"))  # continuous segment_len
 
 
 def budgets_ragged(rs):
@@ -71,7 +72,7 @@ def main():
         model, mc, RolloutConfig(max_prompt_len=P, max_new_tokens=T,
                                  temperature=1.0, quantize_weights=True,
                                  max_batch_size=B, page_size=64,
-                                 segment_len=16),
+                                 segment_len=SEG),
         eos_token_id=None, pad_token_id=0)
     cont.load_weights(params)
 

@@ -1,0 +1,111 @@
+"""N-gram speculative decoding A/B on the chip (VERDICT r4 next #7).
+
+Decode at 1B int8 is bandwidth-bound (see profile_decode.py: the
+weight read alone floors the step), so accepted draft tokens are
+nearly free — each verify step reads the weights once for up to
+speculative_k+1 emitted tokens.  This script measures the real
+multiplier on the simple engine at the ppo1b rollout shape.
+
+Arms: speculative_k in {0, 4, 8} × {greedy, temperature=1}.
+Workload: random prompts (the worst case for prompt-lookup drafting —
+acceptance relies entirely on the model's own output falling into
+n-gram cycles, which random-weight models do produce; real code/math
+text accepts far more).
+
+Metric: wall-clock of engine.generate (one fused dispatch each — the
+tunnel RTT cancels in the ratio), tokens/s, and at temp=0 the
+fraction of rows whose tokens match the k=0 arm.  Bit-identity only
+holds at f32-highest (the CPU parity suite); on-chip, bf16
+accumulation differs across program shapes and near-tie argmaxes
+flip, so LOW agreement on random weights is expected, not a bug —
+the spec path stays self-consistent (tokens verified against, and
+logprobs read from, its own chunk forward).
+
+Run: python scripts/bench_speculative.py
+Env: SPEC_B (32), SPEC_P (256), SPEC_T (128), SPEC_REPS (3).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from orion_tpu.utils.platform import ensure_live_backend
+
+ensure_live_backend(timeout=float(os.environ.get("SPEC_PROBE_S", "30")))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+jax.config.update("jax_compilation_cache_dir", "/tmp/jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 5.0)
+
+B = int(os.environ.get("SPEC_B", "32"))
+P = int(os.environ.get("SPEC_P", "256"))
+T = int(os.environ.get("SPEC_T", "128"))
+REPS = int(os.environ.get("SPEC_REPS", "3"))
+
+
+def main():
+    from orion_tpu.config import ModelConfig, RolloutConfig
+    from orion_tpu.models import Transformer, init_params
+    from orion_tpu.rollout.engine import RolloutEngine
+
+    mc = ModelConfig.pythia_1b()
+    mc.max_seq_len = P + T
+    mc.scan_layers = True
+    model = Transformer(mc)
+    params = init_params(model, jax.random.key(0), mc)
+    rs = np.random.RandomState(0)
+    prompts = jnp.asarray(rs.randint(2, mc.vocab_size, (B, P)), jnp.int32)
+    lens = jnp.full((B,), P, jnp.int32)
+
+    print(f"[spec-decode A/B] backend={jax.devices()[0].platform} "
+          f"pythia-1b int8, B={B} P={P} T={T}", flush=True)
+    for temp in (0.0, 1.0):
+        base_toks = None
+        for k in (0, 4, 8):
+            eng = RolloutEngine(
+                model, mc,
+                RolloutConfig(max_prompt_len=P, max_new_tokens=T,
+                              temperature=temp, quantize_weights=True,
+                              speculative_k=k),
+                eos_token_id=None, pad_token_id=0)
+            eng.load_weights(params)
+            r = eng.generate(prompts, lens, jax.random.key(1))  # compile
+            times = []
+            for rep in range(REPS):
+                t0 = time.perf_counter()
+                r = eng.generate(prompts, lens, jax.random.key(1))
+                np.asarray(r.completion_lens)  # real fetch
+                times.append(time.perf_counter() - t0)
+            toks = np.asarray(r.completions)
+            agree = ""
+            if temp == 0.0:
+                if k == 0:
+                    base_toks = toks
+                else:
+                    # Bitwise equality holds at f32-highest (the CPU
+                    # parity suite) but NOT across bf16 program shapes
+                    # on the chip: plain decode (Lq=1 reference
+                    # attention) and the k+1-wide verify chunk (flash
+                    # kernel) accumulate differently, and near-tie
+                    # argmaxes flip.  Report the agreement instead —
+                    # the spec path stays self-consistent (tokens
+                    # verified against its own chunk logits, behavior
+                    # logprobs from the same forward).
+                    m = (toks == base_toks).all(axis=1).mean()
+                    agree = f"  [rows matching k=0: {m:.0%}]"
+            best = min(times)
+            n_tok = B * T
+            print(f"  temp={temp:.0f} k={k}: {best*1e3:7.1f} ms  "
+                  f"({n_tok/best:6.0f} tok/s){agree}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

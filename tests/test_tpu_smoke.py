@@ -55,6 +55,27 @@ def test_flash_fwd_parity_odd_cache_length():
                                rtol=2e-2, atol=2e-2)
 
 
+def test_flash_fwd_parity_spec_verify_chunk():
+    """The r5 speculative-verify shape: a tiny odd q chunk (Lq = k+1 =
+    5) over a cache whose length (388 = 4·97) has no divisor in
+    [8, 512].  The pre-fix _pick_block chose bkv=4, which Mosaic
+    refuses to lower (second-minor block dim must be %8 == 0 or equal
+    the full array dim); the fix takes one full-dim block.  Like the
+    odd-cache test above, a regression here fails to COMPILE."""
+    from orion_tpu.ops.pallas.flash_attention import flash_attention_gqa
+
+    B, Lq, Lk, H, Hkv, D = 4, 5, 388, 8, 8, 64
+    q, k, v = _qkv(B, Lq, Lk, H, Hkv, D, seed=5)
+    qpos = jnp.broadcast_to(jnp.arange(300, 300 + Lq, dtype=jnp.int32),
+                            (B, Lq))
+    out = jax.jit(lambda q, k, v: flash_attention_gqa(
+        q, k, v, qpos, 0.125))(q, k, v)
+    ref = _dense_ref(q, k, v, qpos, 0.125)
+    np.testing.assert_allclose(np.asarray(out, np.float32),
+                               np.asarray(ref, np.float32),
+                               rtol=2e-2, atol=2e-2)
+
+
 def test_flash_fwd_bwd_parity_square():
     from orion_tpu.ops.pallas.flash_attention import flash_attention_gqa
 
