@@ -89,3 +89,16 @@ def force_cpu_platform(n_devices: Optional[int] = None) -> None:
             "orion_tpu.utils.platform: jax moved the private "
             "xla_bridge._clear_backends API this helper relies on; "
             "update force_cpu_platform for this jax version") from e
+
+
+def enable_compile_cache(path: str = "/tmp/jax_cache",
+                         min_secs: float = 5.0) -> None:
+    """Persistent XLA compile cache: the 1B/8B programs take minutes
+    to build, and every bench/A-B script wants warm re-runs.  One
+    helper so the path/threshold can't drift between scripts.
+    Timing is unaffected — warmup calls absorb compiles either way."""
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir", path)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                      float(min_secs))

@@ -31,8 +31,9 @@ import numpy as np
 
 # pythia-1b decode programs take minutes to build; cache them across
 # runs so iterating on this bench doesn't re-pay XLA every time.
-jax.config.update("jax_compilation_cache_dir", "/tmp/jax_cache")
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 5.0)
+from orion_tpu.utils.platform import enable_compile_cache
+
+enable_compile_cache()
 
 N_REQ = int(os.environ.get("RAGGED_N", "64"))
 B = 32           # simple-engine batch size == continuous slot count

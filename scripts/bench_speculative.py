@@ -42,8 +42,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-jax.config.update("jax_compilation_cache_dir", "/tmp/jax_cache")
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 5.0)
+from orion_tpu.utils.platform import enable_compile_cache
+
+enable_compile_cache()
 
 B = int(os.environ.get("SPEC_B", "32"))
 P = int(os.environ.get("SPEC_P", "256"))
