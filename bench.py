@@ -237,7 +237,11 @@ def main() -> None:
         jax.block_until_ready(trainer.state.params)
         dt = time.perf_counter() - t0
         wc = n_samples * iters / dt
-        rr = [float(x["samples_per_sec"]) for x in h[-iters:]
+        # Copy the window's slice: trainer.train returns the trainer's
+        # shared metrics_history, so a retry would otherwise mutate the
+        # first window's tail out from under us.
+        h = list(h[-iters:])
+        rr = [float(x["samples_per_sec"]) for x in h
               if "samples_per_sec" in x]
         return h, wc, rr
 
@@ -265,7 +269,7 @@ def main() -> None:
 
     mean_new = float(np.mean(
         [h.get("completion_len_mean", cfg.rollout.max_new_tokens)
-         for h in hist[-iters:]]))
+         for h in hist]))  # hist is already the kept window's slice
     toks_per_sec = value * mean_new
     algo = "ppo" if name == "ppo1b" else "grpo"
     fps = flops_per_sample(n_params, cfg, mean_new)

@@ -414,6 +414,13 @@ def init_cache(cfg: ModelConfig, batch: int, max_len: int,
     per-token-per-head f32 scales (RolloutConfig.quantize_kv — see
     ops/quant.py)."""
     dtype = dtype or _dt(cfg.dtype)
+    # Round the length up to a multiple of 8: Mosaic tiles the cache
+    # axis and needs multiple-of-8 blocks (an unlucky max_len like 350
+    # = 2·5²·7 would otherwise force one full-length block — VMEM
+    # pressure at long context, found on-chip r5 via the speculative
+    # verify chunk).  Slots carry the slot==position causal rule, so
+    # the padded tail is masked for every real query.
+    max_len = -(-max_len // 8) * 8
     shape = (batch, max_len, cfg.num_kv_heads, cfg.head_dim)
 
     def layer(pre=()):

@@ -318,12 +318,10 @@ class RolloutEngine:
 
         stochastic = cfg.temperature != 0.0
 
-        # Chunk slack past the budget, rounded up to a multiple of 8:
-        # the flash kernel tiles the cache length, and Mosaic needs
-        # multiple-of-8 tiles — an unlucky P+T+gamma (e.g. 2180 =
-        # 4·545) would otherwise force one full-length block (VMEM
-        # pressure at long context).  Extra slack slots are never
-        # attended, same as the gamma slack itself.
+        # Chunk slack past the budget (init_cache rounds the cache
+        # length itself to a multiple of 8 for Mosaic tiling; the seq
+        # buffer here tracks the same width so draft windows can read
+        # to the end of the cache).
         cap = -(-(P + T + gamma) // 8) * 8
         cache = init_cache(self._decode_cfg, B, cap,
                            dtype=jnp.dtype(self._decode_cfg.dtype),

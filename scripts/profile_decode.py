@@ -134,27 +134,40 @@ def main():
     print(f"prefill ({P} toks): {(t_hi - t_lo)/2*1e3:8.1f} ms")
 
     # ---- 1. full decode step (model + sample + cache write) -----------
-    def mk_steps(n):
-        @jax.jit
-        def f(dparams, cache, tok, rng):
-            def body(i, c):
-                cache, tok, rng, acc = c
-                pos = jnp.full((B, 1), P + i, jnp.int32)
-                logits, cache = dmodel.apply({"params": dparams},
-                                             tok[:, None], pos, cache)
-                rng, sub = jax.random.split(rng)
-                nxt, lp, _ = sample_tokens(sub, logits[:, 0],
-                                           temperature=1.0)
-                return (cache, nxt, rng, acc + lp)
+    def steps_factory(model):
+        def mk(n):
+            @jax.jit
+            def f(params_, cache, tok, rng):
+                def body(i, c):
+                    cache, tok, rng, acc = c
+                    pos = jnp.full((B, 1), P + i, jnp.int32)
+                    logits, cache = model.apply({"params": params_},
+                                                tok[:, None], pos, cache)
+                    rng, sub = jax.random.split(rng)
+                    nxt, lp, _ = sample_tokens(sub, logits[:, 0],
+                                               temperature=1.0)
+                    return (cache, nxt, rng, acc + lp)
 
-            _, _, _, acc = jax.lax.fori_loop(
-                0, n, body, (cache, tok, rng,
-                             jnp.zeros((B,), jnp.float32)))
-            return acc
-        return f
+                _, _, _, acc = jax.lax.fori_loop(
+                    0, n, body, (cache, tok, rng,
+                                 jnp.zeros((B,), jnp.float32)))
+                return acc
+            return f
+        return mk
 
-    t_step = per_rep(mk_steps, dparams, cache, tok0, jax.random.key(2),
-                     label="full decode step")
+    t_step = per_rep(steps_factory(dmodel), dparams, cache, tok0,
+                     jax.random.key(2), label="full decode step")
+
+    # ---- 1b. full decode step, int8 weight-only twin ------------------
+    # (the deployed rollout config: RolloutConfig.quantize_weights)
+    import dataclasses as _dc
+
+    from orion_tpu.ops.quant import quantize_params_int8
+
+    qmodel = type(dmodel)(_dc.replace(dcfg, quantize_dense=True))
+    qparams = jax.jit(quantize_params_int8)(dparams)
+    per_rep(steps_factory(qmodel), qparams, cache, tok0,
+            jax.random.key(2), label="full decode step (int8 weights)")
 
     # ---- 2. matmul stack only (every Dense + lm_head, no attention) ---
     def layer_mats(p, x):
