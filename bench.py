@@ -101,8 +101,14 @@ def _preset(backend: str):
         # unaffected (old-logprobs recomputed under the training graph).
         cfg.rollout.quantize_weights = True
         cfg.rollout.quantize_kv = True
-        cfg.rollout_batch_size = 32
-        # mb sweep on-chip: 4 -> 1161 ms, 8 -> 960, 16 -> 875, 32 OOM.
+        # B sweep on-chip (r5, int8 KV moved the old B=48 OOM wall):
+        # B=32 -> 17.35 samples/s, 48 -> 18.40, 64 -> 18.50 (plateau —
+        # decode rows are ~free, the update scales linearly).  48 keeps
+        # HBM headroom (B=64's 8B-compile leg took 57 s under memory
+        # pressure vs 6 s at 48).
+        cfg.rollout_batch_size = 48
+        # mb sweep on-chip: 4 -> 1161 ms, 8 -> 960, 16 -> 875; mb=32
+        # fits since int8 KV but is SLOWER (17.24 vs 18.50 at B=64).
         cfg.minibatch_size = 16
         cfg.num_epochs = 1
         cfg.kl_coef = 0.05
@@ -130,6 +136,14 @@ def _preset(backend: str):
         cfg.minibatch_size = 4
         cfg.num_epochs = 1
     cfg.rollout.temperature = 1.0
+    # Shape-sweep knobs (r5): decode is bandwidth-bound, so extra
+    # rollout rows are nearly free until the KV pool or the update's
+    # activation memory bites — int8 KV (r4) moved that wall past the
+    # old B=48 OOM.  Overrides apply to any preset.
+    if os.environ.get("ORION_BENCH_B"):
+        cfg.rollout_batch_size = int(os.environ["ORION_BENCH_B"])
+    if os.environ.get("ORION_BENCH_MB"):
+        cfg.minibatch_size = int(os.environ["ORION_BENCH_MB"])
     # Staged on-chip A/B (r5): ORION_BENCH_SPEC=k turns on n-gram
     # speculative decoding for the rollout (exact in both greedy and
     # stochastic modes — see PERF.md).  Off by default until the
@@ -284,6 +298,11 @@ def main() -> None:
 
     self_path = os.path.join(os.path.dirname(__file__), "BENCH_SELF.json")
     key = f"{algo}_samples_per_sec_{name}"
+    # Shape overrides define a DIFFERENT workload: give them their own
+    # baseline key so a sweep can neither poison the canonical
+    # preset's BENCH_SELF entry nor report vs_baseline across shapes.
+    if os.environ.get("ORION_BENCH_B") or os.environ.get("ORION_BENCH_MB"):
+        key += f"_B{cfg.rollout_batch_size}_mb{cfg.minibatch_size}"
     base = {}
     if os.path.exists(self_path):
         with open(self_path) as f:
@@ -306,6 +325,8 @@ def main() -> None:
         "median_samples_per_sec": round(median_rate, 4),
         "iteration_rates": [round(r, 2) for r in rates],
         "stall_retry": stall,
+        "rollout_batch_size": cfg.rollout_batch_size,
+        "minibatch_size": cfg.minibatch_size,
     }
     if backend_err:
         # CPU-fallback run on a sick chip: the number is real but NOT
