@@ -122,7 +122,10 @@ def _preset(backend: str):
             num_kv_heads=8, max_seq_len=1024)
         cfg.rollout.max_prompt_len = 128
         cfg.rollout.max_new_tokens = 128
-        cfg.rollout_batch_size = 8
+        # B sweep on-chip (r5): 8 -> 51.4, 16 -> 59.8, 32 -> 63.3
+        # samples/s (flattening); 16 balances iteration latency vs
+        # throughput.
+        cfg.rollout_batch_size = 16
         cfg.group_size = 4
         cfg.minibatch_size = 8
         cfg.num_epochs = 1
