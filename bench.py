@@ -147,6 +147,11 @@ def _preset(backend: str):
         cfg.rollout_batch_size = int(os.environ["ORION_BENCH_B"])
     if os.environ.get("ORION_BENCH_MB"):
         cfg.minibatch_size = int(os.environ["ORION_BENCH_MB"])
+    if os.environ.get("ORION_BENCH_PAGED") == "1":
+        # A/B the paged decode kernel against the dense cache at the
+        # bench shape (paged KV is block-gathered by the fused Pallas
+        # kernel instead of attended densely).
+        cfg.rollout.paged = True
     # Staged on-chip A/B (r5): ORION_BENCH_SPEC=k turns on n-gram
     # speculative decoding for the rollout (exact in both greedy and
     # stochastic modes — see PERF.md).  Off by default until the
