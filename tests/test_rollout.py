@@ -148,3 +148,29 @@ def test_windowed_logprobs_match_full(tiny_setup):
     win = windowed_completion_logprobs(logits_w, seqs, lens, T)
     np.testing.assert_allclose(np.asarray(win), np.asarray(full),
                                rtol=1e-6, atol=1e-6)
+
+
+def test_cache_length_rounds_to_multiple_of_8(tiny_setup):
+    """init_cache pads the cache axis to a multiple of 8 (Mosaic tile
+    legality — the r5 on-chip sub-8 block failure), and generation at
+    an unlucky max_prompt+max_new (30+25=55 -> 56) is unaffected: the
+    padded tail is masked by the slot==position causal rule."""
+    from orion_tpu.models.transformer import init_cache, make_decode_twin
+
+    cfg, model, params = tiny_setup
+    _, dcfg = make_decode_twin(model, cfg)
+    cache = init_cache(dcfg, 2, 55, dtype=jnp.float32)
+    leaf = cache[0]["k"] if isinstance(cache, list) else cache["k"]
+    assert leaf.shape[1] == 56
+
+    rcfg = RolloutConfig(temperature=0.0, max_prompt_len=30,
+                         max_new_tokens=25)
+    eng = RolloutEngine(model, cfg, rcfg, eos_token_id=None)
+    eng.load_weights(params)
+    rng = np.random.RandomState(0)
+    ids = jnp.asarray(rng.randint(1, cfg.vocab_size, (2, 30)), jnp.int32)
+    lens = jnp.asarray([30, 17], jnp.int32)
+    res = eng.generate(ids, lens, jax.random.key(1),
+                       max_new_tokens=25)
+    assert res.completions.shape == (2, 25)
+    assert np.isfinite(np.asarray(res.policy_logprobs)).all()
