@@ -1,0 +1,126 @@
+"""Cross-process channel for the decoupled rollout/learner split.
+
+JAX on multi-host pods is multi-controller for GLOBAL-mesh programs —
+every process must execute the same program over the same devices.  A
+decoupled async split (SURVEY.md §3b: rollout group and learner group
+running DIFFERENT programs at their own cadence) therefore cannot put
+both groups in one mesh; instead each process group drives a mesh of
+its LOCAL devices only, and the two things that cross the process
+boundary travel host-side:
+
+- trajectory batches (rollout → learner): ``GenerationResult`` fields
+  + scores as numpy,
+- weight snapshots (learner → rollout): the param tree as numpy,
+  version-tagged for the staleness gate.
+
+This is the DCN-through-host hop every decoupled RLHF stack has (the
+reference's rollout workers feed the learner through an object store /
+parameter channel the same way); XLA collectives still carry all
+INTRA-group traffic over ICI.  ``tests/test_multihost.py::
+test_two_process_async_decoupled`` runs the full pattern on two real
+processes.
+
+Wire format: length-prefixed pickle of numpy pytrees.  Pickle is safe
+here: both endpoints are processes of the same training job on a
+private port, which is the same trust domain as the checkpoint files
+they already exchange.
+"""
+
+from __future__ import annotations
+
+import pickle
+import socket
+import struct
+import time
+from typing import Any, Optional
+
+import jax
+import numpy as np
+
+_LEN = struct.Struct(">Q")
+
+
+def host_tree(tree: Any) -> Any:
+    """Numpy copy of a jax pytree via ONE batched device→host
+    transfer (per-leaf ``np.asarray`` would pay a round-trip each on
+    a tunneled TPU)."""
+    return jax.tree.map(np.asarray, jax.device_get(tree))
+
+
+class PyTreeChannel:
+    """Blocking point-to-point pytree channel over TCP."""
+
+    def __init__(self, sock: socket.socket):
+        self._sock = sock
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+
+    @classmethod
+    def listen(cls, port: int, host: str = "localhost",
+               timeout: float = 120.0) -> "PyTreeChannel":
+        """Accept exactly one peer (the rollout worker)."""
+        srv = socket.socket()
+        srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        srv.bind((host, port))
+        srv.listen(1)
+        srv.settimeout(timeout)
+        try:
+            conn, _ = srv.accept()
+        finally:
+            srv.close()
+        return cls(conn)
+
+    @classmethod
+    def connect(cls, port: int, host: str = "localhost",
+                timeout: float = 120.0) -> "PyTreeChannel":
+        """Connect to the listening peer, retrying until it is up."""
+        deadline = time.monotonic() + timeout
+        while True:
+            try:
+                sock = socket.create_connection((host, port),
+                                                timeout=timeout)
+                # The timeout above governs only connection setup; a
+                # connected channel must block indefinitely (a learner
+                # can legitimately spend minutes inside one compile).
+                sock.settimeout(None)
+                return cls(sock)
+            except OSError:
+                if time.monotonic() >= deadline:
+                    raise
+                time.sleep(0.1)
+
+    def send(self, tree: Any) -> None:
+        # Header and payload go out separately: concatenating would
+        # materialize a second full copy of a multi-GB weight snapshot.
+        payload = pickle.dumps(tree, protocol=pickle.HIGHEST_PROTOCOL)
+        self._sock.sendall(_LEN.pack(len(payload)))
+        self._sock.sendall(payload)
+
+    def recv(self) -> Any:
+        n = _LEN.unpack(self._recv_exact(_LEN.size))[0]
+        buf = bytearray(n)
+        view = memoryview(buf)
+        got = 0
+        while got < n:
+            r = self._sock.recv_into(view[got:])
+            if not r:
+                raise ConnectionError(
+                    "pytree channel peer closed mid-message")
+            got += r
+        return pickle.loads(view)
+
+    def _recv_exact(self, n: int) -> bytes:
+        buf = bytearray()
+        while len(buf) < n:
+            chunk = self._sock.recv(n - len(buf))
+            if not chunk:
+                raise ConnectionError(
+                    "pytree channel peer closed mid-message")
+            buf.extend(chunk)
+        return bytes(buf)
+
+    def close(self) -> None:
+        try:
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        self._sock.close()

@@ -192,3 +192,130 @@ def test_two_process_full_grpo_iteration():
     same rewards, same post-update parameter norm."""
     results = _run_two_process(_TRAINER_WORKER, timeout=420)
     assert results[0] == results[1], results
+
+
+_ASYNC_WORKER = r"""
+import os, sys
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+try:
+    import jax._src.xla_bridge as xb
+    xb._clear_backends()
+except Exception:
+    pass
+
+coord, pid = sys.argv[1], int(sys.argv[2])
+port = int(coord.split(":")[1])  # reuse the test's free port for the channel
+
+import numpy as np
+import jax.numpy as jnp
+from orion_tpu.config import (GRPOConfig, MeshConfig, ModelConfig,
+                              OptimizerConfig, RolloutConfig)
+from orion_tpu.models import Transformer
+from orion_tpu.orchestration.remote import PyTreeChannel, host_tree
+from orion_tpu.rollout.engine import GenerationResult, RolloutEngine
+
+LUCKY = 7
+N = 3
+
+mcfg = ModelConfig.tiny(vocab_size=64, hidden_size=32,
+                        intermediate_size=64, num_layers=2, num_heads=2,
+                        num_kv_heads=2, dtype="float32")
+rcfg = RolloutConfig(max_new_tokens=8, max_prompt_len=8, temperature=1.0)
+cfg = GRPOConfig(model=mcfg,
+                 optimizer=OptimizerConfig(learning_rate=5e-3,
+                                           grad_clip=1.0),
+                 rollout=rcfg, rollout_batch_size=4, minibatch_size=8,
+                 group_size=2, kl_coef=0.0, num_epochs=1, log_every=0,
+                 async_mode=True, async_staleness=1)
+
+if pid == 0:
+    # ---- learner process: local mesh, updates from received batches --
+    from orion_tpu.models.sharded import make_sharded_model
+    from orion_tpu.parallel.mesh import make_mesh
+    from orion_tpu.trainers import GRPOTrainer
+
+    mesh = make_mesh(MeshConfig(data=1, fsdp=2, seq=1, tensor=2),
+                     jax.devices())
+    with mesh:
+        model = Transformer(mcfg)
+        params, _ = make_sharded_model(
+            model, mesh, jax.random.key(0),
+            (jnp.zeros((1, 2), jnp.int32), jnp.zeros((1, 2), jnp.int32)))
+        trainer = GRPOTrainer(cfg, model, params, reward_fn=None,
+                              eos_token_id=None)
+        chan = PyTreeChannel.listen(port)
+        version = 0
+        chan.send({"version": version,
+                   "params": host_tree(trainer.state.params)})
+        staleness_seen, losses, rewards = [], [], []
+        for it in range(N):
+            msg = chan.recv()
+            staleness_seen.append(version - msg["version"])
+            result = GenerationResult(**msg["result"])
+            experience, _ = trainer.build_experience(result, msg["scores"])
+            stats = trainer.update_epochs(experience)
+            losses.append(float(stats["loss"]))
+            rewards.append(float(np.mean(msg["scores"])))
+            version += 1
+            chan.send({"version": version,
+                       "params": host_tree(trainer.state.params)})
+        chan.close()
+        assert staleness_seen == [0, 1, 1], staleness_seen
+        assert all(np.isfinite(l) for l in losses), losses
+        print("RESULT 0 staleness=" + ",".join(map(str, staleness_seen))
+              + " rewards=" + ",".join(f"{r:.3f}" for r in rewards),
+              flush=True)
+else:
+    # ---- rollout process: its own engine, one batch always in flight -
+    model = Transformer(mcfg)
+    eng = RolloutEngine(model, mcfg, rcfg, eos_token_id=None,
+                        pad_token_id=0)
+    chan = PyTreeChannel.connect(port)
+    w = chan.recv()
+    eng.load_weights(jax.device_put(w["params"]))
+    rs = np.random.RandomState(123)
+
+    def make_batch(i, version):
+        ids = np.repeat(rs.randint(1, 64, size=(4, 6)).astype(np.int32),
+                        2, axis=0)
+        lens = np.full((8,), 6, np.int32)
+        result = eng.generate(jnp.asarray(ids), jnp.asarray(lens),
+                              jax.random.key(100 + i))
+        host = result.to_host()
+        comp = np.asarray(host.completions)
+        mask = np.asarray(host.completion_mask)
+        scores = ((comp == LUCKY) * mask).sum(axis=1).astype(np.float32)
+        chan.send({"result": host._fields(), "scores": scores,
+                   "version": version})
+
+    # two batches on v0 keep the pipeline one deep (true async: the
+    # learner updates while this worker is already generating ahead)
+    make_batch(0, w["version"])
+    make_batch(1, w["version"])
+    for i in range(2, N):
+        w = chan.recv()
+        eng.load_weights(jax.device_put(w["params"]))
+        make_batch(i, w["version"])
+    for _ in range(2):  # drain the learner's remaining weight sends
+        w = chan.recv()
+    chan.close()
+    print("RESULT 1 ok", flush=True)
+"""
+
+
+def test_two_process_async_decoupled():
+    """The decoupled async split across two REAL processes (the r5
+    known-open item): a learner process updating on its own local
+    sharded mesh and a rollout process generating on its own devices,
+    with weights and trajectory batches crossing host-side through
+    orion_tpu.orchestration.remote.PyTreeChannel — the DCN-through-
+    host hop of a real multi-host pod.  The rollout worker keeps one
+    batch in flight, so the learner must observe the staleness
+    sequence [0, 1, 1] — proof the two groups genuinely overlap
+    rather than alternating in lockstep."""
+    results = _run_two_process(_ASYNC_WORKER, timeout=420)
+    assert results[1] == ("ok",), results
+    assert results[0][0] == "staleness=0,1,1", results
