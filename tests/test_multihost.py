@@ -269,38 +269,54 @@ if pid == 0:
               + " rewards=" + ",".join(f"{r:.3f}" for r in rewards),
               flush=True)
 else:
-    # ---- rollout process: its own engine, one batch always in flight -
-    model = Transformer(mcfg)
-    eng = RolloutEngine(model, mcfg, rcfg, eos_token_id=None,
-                        pad_token_id=0)
+    # ---- rollout process: SHARDED engine on its own local mesh, one
+    # batch always in flight.  Received host snapshots are installed
+    # directly sharded (the cross-process reshard: host numpy ->
+    # device_put with this mesh's computed shardings).
+    from orion_tpu.models.sharded import make_sharded_model
+    from orion_tpu.parallel.mesh import make_mesh
+    from orion_tpu.utils.placement import replicated_put
+
+    mesh = make_mesh(MeshConfig(data=1, fsdp=2, seq=1, tensor=2),
+                     jax.devices())
     chan = PyTreeChannel.connect(port)
     w = chan.recv()
-    eng.load_weights(jax.device_put(w["params"]))
-    rs = np.random.RandomState(123)
+    with mesh:
+        model = Transformer(mcfg)
+        params, shardings = make_sharded_model(
+            model, mesh, jax.random.key(0),
+            (jnp.zeros((1, 2), jnp.int32), jnp.zeros((1, 2), jnp.int32)),
+            host_params=w["params"])
+        eng = RolloutEngine(model, mcfg, rcfg, eos_token_id=None,
+                            pad_token_id=0)
+        eng.load_weights(params)
+        rs = np.random.RandomState(123)
 
-    def make_batch(i, version):
-        ids = np.repeat(rs.randint(1, 64, size=(4, 6)).astype(np.int32),
-                        2, axis=0)
-        lens = np.full((8,), 6, np.int32)
-        result = eng.generate(jnp.asarray(ids), jnp.asarray(lens),
-                              jax.random.key(100 + i))
-        host = result.to_host()
-        comp = np.asarray(host.completions)
-        mask = np.asarray(host.completion_mask)
-        scores = ((comp == LUCKY) * mask).sum(axis=1).astype(np.float32)
-        chan.send({"result": host._fields(), "scores": scores,
-                   "version": version})
+        def make_batch(i, version):
+            ids = np.repeat(
+                rs.randint(1, 64, size=(4, 6)).astype(np.int32), 2, axis=0)
+            lens = np.full((8,), 6, np.int32)
+            dids, dlens = replicated_put(
+                (jnp.asarray(ids), jnp.asarray(lens)), params)
+            result = eng.generate(dids, dlens, jax.random.key(100 + i))
+            host = result.to_host()
+            comp = np.asarray(host.completions)
+            mask = np.asarray(host.completion_mask)
+            scores = ((comp == LUCKY) * mask).sum(axis=1).astype(np.float32)
+            chan.send({"result": host._fields(), "scores": scores,
+                       "version": version})
 
-    # two batches on v0 keep the pipeline one deep (true async: the
-    # learner updates while this worker is already generating ahead)
-    make_batch(0, w["version"])
-    make_batch(1, w["version"])
-    for i in range(2, N):
-        w = chan.recv()
-        eng.load_weights(jax.device_put(w["params"]))
-        make_batch(i, w["version"])
-    for _ in range(2):  # drain the learner's remaining weight sends
-        w = chan.recv()
+        # two batches on v0 keep the pipeline one deep (true async: the
+        # learner updates while this worker is already generating ahead)
+        make_batch(0, w["version"])
+        make_batch(1, w["version"])
+        for i in range(2, N):
+            w = chan.recv()
+            params = jax.device_put(w["params"], shardings)
+            eng.load_weights(params)
+            make_batch(i, w["version"])
+        for _ in range(2):  # drain the learner's remaining weight sends
+            w = chan.recv()
     chan.close()
     print("RESULT 1 ok", flush=True)
 """
