@@ -269,37 +269,78 @@ if pid == 0:
               + " rewards=" + ",".join(f"{r:.3f}" for r in rewards),
               flush=True)
 else:
-    # ---- rollout process: SHARDED engine on its own local mesh, one
-    # batch always in flight.  Received host snapshots are installed
-    # directly sharded (the cross-process reshard: host numpy ->
-    # device_put with this mesh's computed shardings).
-    from orion_tpu.models.sharded import make_sharded_model
-    from orion_tpu.parallel.mesh import make_mesh
-    from orion_tpu.utils.placement import replicated_put
-
-    mesh = make_mesh(MeshConfig(data=1, fsdp=2, seq=1, tensor=2),
-                     jax.devices())
+    # ---- rollout process, one batch always in flight ----------------
+    ENGINE = "__ENGINE__"
     chan = PyTreeChannel.connect(port)
     w = chan.recv()
-    with mesh:
-        model = Transformer(mcfg)
-        params, shardings = make_sharded_model(
-            model, mesh, jax.random.key(0),
-            (jnp.zeros((1, 2), jnp.int32), jnp.zeros((1, 2), jnp.int32)),
-            host_params=w["params"])
-        eng = RolloutEngine(model, mcfg, rcfg, eos_token_id=None,
-                            pad_token_id=0)
-        eng.load_weights(params)
-        rs = np.random.RandomState(123)
+    rs = np.random.RandomState(123)
 
-        def make_batch(i, version):
+    if ENGINE == "simple":
+        # SHARDED engine on its own local mesh: received host
+        # snapshots are installed directly sharded (the cross-process
+        # reshard: host numpy -> device_put with this mesh's computed
+        # shardings).
+        from orion_tpu.models.sharded import make_sharded_model
+        from orion_tpu.parallel.mesh import make_mesh
+        from orion_tpu.utils.placement import replicated_put
+
+        mesh = make_mesh(MeshConfig(data=1, fsdp=2, seq=1, tensor=2),
+                         jax.devices())
+        ctx = mesh
+        model = Transformer(mcfg)
+        with mesh:
+            params, shardings = make_sharded_model(
+                model, mesh, jax.random.key(0),
+                (jnp.zeros((1, 2), jnp.int32),
+                 jnp.zeros((1, 2), jnp.int32)),
+                host_params=w["params"])
+            eng = RolloutEngine(model, mcfg, rcfg, eos_token_id=None,
+                                pad_token_id=0)
+            eng.load_weights(params)
+
+        def install(tree):
+            eng.load_weights(jax.device_put(tree, shardings))
+
+        def gen(i):
             ids = np.repeat(
-                rs.randint(1, 64, size=(4, 6)).astype(np.int32), 2, axis=0)
+                rs.randint(1, 64, size=(4, 6)).astype(np.int32), 2,
+                axis=0)
             lens = np.full((8,), 6, np.int32)
             dids, dlens = replicated_put(
-                (jnp.asarray(ids), jnp.asarray(lens)), params)
-            result = eng.generate(dids, dlens, jax.random.key(100 + i))
-            host = result.to_host()
+                (jnp.asarray(ids), jnp.asarray(lens)),
+                eng._params)
+            return eng.generate(dids, dlens,
+                                jax.random.key(100 + i)).to_host()
+    else:
+        # Continuous engine, unsharded local devices: host prompt
+        # arrays in, host GenerationResult out, with shared-prefix
+        # GROUP admission (4 unique prompts x k=2 clones per batch).
+        import contextlib
+
+        from orion_tpu.rollout.continuous import ContinuousBatchingEngine
+
+        ctx = contextlib.nullcontext()
+        ccfg = RolloutConfig(max_new_tokens=8, max_prompt_len=8,
+                             temperature=1.0, max_batch_size=8,
+                             page_size=8, segment_len=4)
+        model = Transformer(mcfg)
+        eng = ContinuousBatchingEngine(model, mcfg, ccfg,
+                                       eos_token_id=None,
+                                       pad_token_id=0)
+        eng.load_weights(jax.device_put(w["params"]))
+
+        def install(tree):
+            eng.load_weights(jax.device_put(tree))
+
+        def gen(i):
+            ids = rs.randint(1, 64, size=(4, 6)).astype(np.int32)
+            lens = np.full((4,), 6, np.int32)
+            return eng.generate_batch(ids, lens, jax.random.key(100 + i),
+                                      group_size=2)
+
+    with ctx:
+        def make_batch(i, version):
+            host = gen(i)
             comp = np.asarray(host.completions)
             mask = np.asarray(host.completion_mask)
             scores = ((comp == LUCKY) * mask).sum(axis=1).astype(np.float32)
@@ -312,8 +353,7 @@ else:
         make_batch(1, w["version"])
         for i in range(2, N):
             w = chan.recv()
-            params = jax.device_put(w["params"], shardings)
-            eng.load_weights(params)
+            install(w["params"])
             make_batch(i, w["version"])
         for _ in range(2):  # drain the learner's remaining weight sends
             w = chan.recv()
@@ -322,7 +362,8 @@ else:
 """
 
 
-def test_two_process_async_decoupled():
+@pytest.mark.parametrize("engine", ["simple", "continuous"])
+def test_two_process_async_decoupled(engine):
     """The decoupled async split across two REAL processes (the r5
     known-open item): a learner process updating on its own local
     sharded mesh and a rollout process generating on its own devices,
@@ -331,7 +372,11 @@ def test_two_process_async_decoupled():
     host hop of a real multi-host pod.  The rollout worker keeps one
     batch in flight, so the learner must observe the staleness
     sequence [0, 1, 1] — proof the two groups genuinely overlap
-    rather than alternating in lockstep."""
-    results = _run_two_process(_ASYNC_WORKER, timeout=420)
+    rather than alternating in lockstep.  engine="simple" runs a
+    SHARDED rollout mesh with direct-sharded snapshot installs;
+    engine="continuous" runs the paged continuous engine with
+    shared-prefix group admission feeding the same channel."""
+    results = _run_two_process(
+        _ASYNC_WORKER.replace("__ENGINE__", engine), timeout=420)
     assert results[1] == ("ok",), results
     assert results[0][0] == "staleness=0,1,1", results
