@@ -57,7 +57,11 @@ def scale_by_adam_lp(b1: float = 0.9, b2: float = 0.999,
 
         mu = jax.tree.map(upd_mu, updates, state.mu)
         nu = jax.tree.map(upd_nu, updates, state.nu)
-        count = optax.safe_increment(state.count)
+        # optax renamed safe_int32_increment -> safe_increment; this
+        # box's 0.2.3 only has the old name, newer drops it.
+        _safe_inc = getattr(optax, "safe_increment", None) or \
+            optax.safe_int32_increment
+        count = _safe_inc(state.count)
         bc1 = 1 - b1 ** count.astype(f32)
         bc2 = 1 - b2 ** count.astype(f32)
         new_updates = jax.tree.map(

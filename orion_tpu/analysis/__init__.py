@@ -1,0 +1,34 @@
+"""JAX/TPU-aware static analysis for the orion-tpu tree.
+
+An AST lint engine (stdlib ``ast``, zero deps) with rules tuned to the
+failure modes that rot a TPU RLHF stack silently: host syncs inside
+jitted hot paths, PRNG key reuse, compat-shim bypasses that ImportError
+on this box's jax, donated buffers read after the donating call, and
+benchmark timings that measure a dispatch instead of the computation.
+
+Run it::
+
+    python -m orion_tpu.analysis orion_tpu tests scripts
+
+Suppress a finding on one line with a justification comment::
+
+    x = big.item()  # orion: ignore[host-sync-in-jit] eager debug path
+
+The repo self-gates: ``tests/test_analysis.py`` runs this engine over
+``orion_tpu/`` and fails on any unsuppressed finding.
+"""
+
+from orion_tpu.analysis.engine import (Finding, analyze_file, analyze_paths,
+                                       analyze_source, iter_python_files)
+from orion_tpu.analysis.report import format_findings
+from orion_tpu.analysis.rules import RULES
+
+__all__ = [
+    "Finding",
+    "RULES",
+    "analyze_file",
+    "analyze_paths",
+    "analyze_source",
+    "format_findings",
+    "iter_python_files",
+]

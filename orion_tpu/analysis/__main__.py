@@ -1,0 +1,57 @@
+"""CLI: ``python -m orion_tpu.analysis <paths>`` — nonzero exit on any
+unsuppressed finding, so scripts/lint.sh and CI can gate on it."""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import List, Optional
+
+from orion_tpu.analysis.engine import analyze_paths
+from orion_tpu.analysis.report import format_findings, format_rule_table
+from orion_tpu.analysis.rules import RULES
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m orion_tpu.analysis",
+        description="JAX/TPU-aware static analysis for the orion-tpu "
+                    "tree (AST-based, stdlib-only)")
+    parser.add_argument("paths", nargs="*",
+                        help="files or directories to analyze")
+    parser.add_argument("--list-rules", action="store_true",
+                        help="print the rule table and exit")
+    parser.add_argument("--rule", action="append", default=None,
+                        metavar="RULE-ID",
+                        help="run only these rules (repeatable)")
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        print(format_rule_table())
+        return 0
+    if not args.paths:
+        parser.error("no paths given (try: python -m orion_tpu.analysis "
+                     "orion_tpu tests scripts)")
+
+    rules = None
+    if args.rule:
+        known = {r.id: r for r in RULES}
+        unknown = [r for r in args.rule if r not in known]
+        if unknown:
+            parser.error(f"unknown rule id(s): {', '.join(unknown)} "
+                         "(--list-rules shows the registry)")
+        rules = [known[r] for r in args.rule]
+
+    try:
+        findings = analyze_paths(args.paths, rules=rules)
+    except FileNotFoundError as e:
+        print(str(e), file=sys.stderr)
+        return 2
+    if findings:
+        print(format_findings(findings))
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

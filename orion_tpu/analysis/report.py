@@ -1,0 +1,29 @@
+"""Findings report: one line per finding, file:line first so terminals
+and editors can jump to it, plus a one-line fix hint."""
+
+from __future__ import annotations
+
+from typing import List, Sequence
+
+from orion_tpu.analysis.engine import Finding
+
+
+def format_findings(findings: Sequence[Finding]) -> str:
+    lines: List[str] = []
+    for f in findings:
+        lines.append(f"{f.path}:{f.line}: [{f.rule_id}] {f.message}")
+        if f.hint:
+            lines.append(f"    hint: {f.hint}")
+    if findings:
+        n = len(findings)
+        lines.append(f"{n} finding{'s' if n != 1 else ''} "
+                     "(suppress a justified one with "
+                     "'# orion: ignore[rule-id] <why>')")
+    return "\n".join(lines)
+
+
+def format_rule_table() -> str:
+    from orion_tpu.analysis.rules import RULES
+
+    width = max(len(r.id) for r in RULES)
+    return "\n".join(f"{r.id:<{width}}  {r.description}" for r in RULES)

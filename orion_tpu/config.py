@@ -407,6 +407,14 @@ class TrainConfig:
     async_mode: bool = False
     async_staleness: int = 1  # max steps rollout weights may lag
     rollout_devices: int = 0  # devices reserved for rollout group (async)
+    # Runtime guards (orion_tpu.analysis.runtime_guards).
+    # transfer_guard: jax.transfer_guard level applied around the train
+    # loop — None/"allow" off, "log" prints every IMPLICIT host
+    # transfer, "disallow" raises on them (explicit device_get fetches
+    # stay allowed).  recompile_budget: warn when any single jitted fn
+    # compiles more than this many times (0 disables the sentinel).
+    transfer_guard: Optional[str] = None
+    recompile_budget: int = 0
 
 
 @dataclass

@@ -191,8 +191,9 @@ def paged_decode_attention_sharded(q, k_pages, v_pages, block_tables,
         return paged_decode_attention(q, k_pages, v_pages, block_tables,
                                       seq_lens, scale, k_scales=k_scales,
                                       v_scales=v_scales)
-    from jax import shard_map
     from jax.sharding import PartitionSpec as P
+
+    from orion_tpu.utils.platform import shard_map
 
     pool_spec = P(None, "tensor", None, None)
     args = [q, k_pages, v_pages]

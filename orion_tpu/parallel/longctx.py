@@ -40,6 +40,7 @@ import numpy as np
 from jax import lax
 
 from orion_tpu.ops.attention import repeat_kv
+from orion_tpu.utils.platform import axis_size
 
 _NEG_INF = -1e30
 
@@ -59,7 +60,7 @@ def ulysses_attention(q, k, v, q_positions, scale: float,
     """
     from orion_tpu.ops.attention import attention
 
-    s = lax.axis_size(axis_name)
+    s = axis_size(axis_name)
     H, Hkv = q.shape[2], k.shape[2]
     if H % s or Hkv % s:
         raise ValueError(
@@ -92,7 +93,7 @@ def ring_attention_reference(q, k, v, q_positions, kv_positions,
     numerics oracle for the flash-blockwise path in tests.  Prefer
     :func:`ring_attention` (O(block) memory per chunk) everywhere else.
     """
-    s = lax.axis_size(axis_name)
+    s = axis_size(axis_name)
     B, Lq, H, D = q.shape
     n_rep = H // k.shape[2]
     qf = q.astype(jnp.float32) * scale
@@ -151,7 +152,7 @@ def ring_attention(q, k, v, q_positions, kv_positions, scale: float,
 def _ring_fwd_loop(q, k, v, q_positions, kv_positions, scale, axis_name):
     from orion_tpu.ops.pallas.flash_attention import flash_chunk_fwd
 
-    s = lax.axis_size(axis_name)
+    s = axis_size(axis_name)
     B, Lq, H, D = q.shape
     perm = [(i, (i + 1) % s) for i in range(s)]
 
@@ -189,7 +190,7 @@ def _ring_vjp_bwd(scale, axis_name, residuals, dout):
     from orion_tpu.ops.pallas.flash_attention import flash_chunk_grads
 
     q, k, v, q_positions, kv_positions, out, glse = residuals
-    s = lax.axis_size(axis_name)
+    s = axis_size(axis_name)
     perm = [(i, (i + 1) % s) for i in range(s)]
     glse_t = glse.transpose(0, 2, 1)                      # [B, H, Lq]
 

@@ -27,10 +27,10 @@ from typing import Any, Optional
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
-from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from orion_tpu.config import ModelConfig
+from orion_tpu.utils.platform import axis_size, shard_map
 
 
 def stack_to_stages(stacked: Any, n_stages: int) -> Any:
@@ -85,7 +85,7 @@ def pipeline_blocks(cfg: ModelConfig, stage_params, x, positions,
     Returns [B, L, E] final-block activations, replicated (psum of the
     last stage's collected outputs).
     """
-    S = jax.lax.axis_size(axis)
+    S = axis_size(axis)
     s = jax.lax.axis_index(axis)
     M = n_microbatches
     B = x.shape[0]

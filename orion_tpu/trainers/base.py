@@ -237,6 +237,29 @@ class BaseTrainer:
             from orion_tpu.utils.metrics import MetricsWriter
 
             self.writer = MetricsWriter(cfg.log_dir)
+        # Opt-in runtime guards (orion_tpu.analysis.runtime_guards):
+        # recompile sentinel installs here; the transfer guard wraps
+        # the train() loop body.
+        from orion_tpu.analysis.runtime_guards import install_from_config
+
+        self._recompile_sentinel = install_from_config(cfg)
+
+    def close(self) -> None:
+        """Release process-global hooks (the recompile sentinel's log
+        handler + jax_log_compiles flag).  Idempotent; also runs from
+        __del__ so sweep scripts constructing many trainers don't
+        accumulate handlers, but an explicit close() is the reliable
+        path."""
+        sentinel = getattr(self, "_recompile_sentinel", None)
+        if sentinel is not None:
+            sentinel.uninstall()
+            self._recompile_sentinel = None
+
+    def __del__(self):  # pragma: no cover - interpreter-dependent
+        try:
+            self.close()
+        except Exception:
+            pass
 
     # ------------------------------------------------------------------
     # jitted helpers
@@ -471,7 +494,9 @@ class BaseTrainer:
         assert B % mb == 0, f"batch {B} not divisible by minibatch {mb}"
         perms = np.stack([self._np_rng.permutation(B)
                           for _ in range(self.cfg.num_epochs)])
-        idx_mat = jnp.asarray(perms.reshape(-1, mb).astype(np.int32))
+        # explicit H2D put: stays legal under TrainConfig.transfer_guard
+        # ("disallow" only rejects IMPLICIT transfers)
+        idx_mat = jax.device_put(perms.reshape(-1, mb).astype(np.int32))
         stats = self._run_epochs(experience, idx_mat)
         if defer:
             return stats
@@ -642,6 +667,8 @@ class BaseTrainer:
         # device never idles waiting for a stats fetch.  The KL
         # controller update keeps its eager-path position (before the
         # next build_experience).
+        from orion_tpu.analysis.runtime_guards import guard_scope
+
         pending = None
         self._defer_stats = True
         try:
@@ -659,10 +686,12 @@ class BaseTrainer:
                     pending["t_next"] = t0
                     self._pending_meta = pending
                     pending = None
-                with jax.named_scope("experience"):
+                with guard_scope(self.cfg.transfer_guard), \
+                        jax.named_scope("experience"):
                     experience, exp_stats = self.make_experience(batch)
                 t1 = time.perf_counter()
-                with jax.named_scope("update"):
+                with guard_scope(self.cfg.transfer_guard), \
+                        jax.named_scope("update"):
                     upd_dev = self.update_epochs(experience, defer=True)
                 self.sync_weights()
                 t2 = time.perf_counter()

@@ -1,4 +1,4 @@
-"""Process-level platform pinning.
+"""Process-level platform pinning + jax-version compat shims.
 
 One place for the pin-CPU-before-any-backend-init dance that the test
 harness, the driver hooks, and the bench all need: this box's
@@ -7,6 +7,21 @@ start, and a sick tunnel HANGS (not errors) the first touch of that
 backend inside ``make_c_api_client`` — so every CPU-only entrypoint
 must pin the platform *and* drop any backend jax already built, before
 its first ``jax.devices()``/jit dispatch.
+
+Also the compat layer for the jax on this box (0.4.37):
+
+- :func:`shard_map` — the new-style ``jax.shard_map`` keyword API
+  (``mesh=``/``in_specs=``/``axis_names=``/``check_vma=``) mapped onto
+  ``jax.experimental.shard_map`` where ``jax.shard_map`` is missing.
+  Partial-manual mode (``axis_names`` a strict subset of the mesh axes)
+  is degraded to fully-manual with a once-per-shape warning: this
+  version's SPMD partitioner hard-crashes lowering manual-axis
+  collectives (ppermute) inside a partially-auto shard_map.
+- :func:`axis_size` — ``lax.axis_size`` via the ``psum(1)`` idiom on
+  versions that lack it.
+
+All orion-tpu code MUST route shard_map/axis_size through these shims;
+``orion_tpu.analysis`` rule ``compat-import`` enforces it.
 """
 
 from __future__ import annotations
@@ -14,7 +29,8 @@ from __future__ import annotations
 import os
 import subprocess
 import sys
-from typing import Optional, Tuple
+import warnings
+from typing import Optional, Set, Tuple
 
 
 def probe_backend(timeout: float = 90, attempts: int = 2) -> Tuple[str, str]:
@@ -89,6 +105,83 @@ def force_cpu_platform(n_devices: Optional[int] = None) -> None:
             "orion_tpu.utils.platform: jax moved the private "
             "xla_bridge._clear_backends API this helper relies on; "
             "update force_cpu_platform for this jax version") from e
+
+
+# ---------------------------------------------------------------------------
+# jax-version compat shims (jax 0.4.37 on this box)
+# ---------------------------------------------------------------------------
+
+_PARTIAL_MANUAL_WARNED: Set[tuple] = set()
+
+
+def axis_size(axis_name):
+    """``lax.axis_size(axis_name)`` under any jax: falls back to the
+    ``psum(1, axis)`` idiom where the API is missing (0.4.37).  Call
+    inside shard_map/pmap scope, exactly like the real thing."""
+    from jax import lax
+
+    if hasattr(lax, "axis_size"):
+        return lax.axis_size(axis_name)
+    return lax.psum(1, axis_name)
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, axis_names=None,
+              check_vma=None, check_rep=None):
+    """New-style ``jax.shard_map`` keyword API on any jax version.
+
+    ``axis_names``: the MANUALLY mapped mesh axes (None => all of
+    them); the rest stay auto (GSPMD shards them from the arrays' own
+    NamedShardings).  ``check_vma`` is the new name for ``check_rep``;
+    either spelling is accepted and forwarded.
+
+    On jax with native ``jax.shard_map`` this forwards unchanged.  On
+    0.4.37 it maps onto ``jax.experimental.shard_map`` — and degrades
+    partial-manual to FULLY-manual (auto axes' inputs get gathered per
+    the in_specs) with a once-per-mesh-shape warning, because this
+    version's SPMD partitioner cannot lower manual-axis collectives
+    (ppermute) inside a partially-auto shard_map: it hard-crashes at
+    compile time.  Correctness is preserved; the auto axes lose their
+    sharding inside the mapped body only.
+    """
+    import jax
+
+    rep = check_vma if check_vma is not None else check_rep
+    if hasattr(jax, "shard_map"):  # jax >= 0.6-style native API
+        kw = {}
+        if axis_names is not None:
+            kw["axis_names"] = set(axis_names)
+        if rep is not None:
+            kw["check_vma"] = bool(rep)
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, **kw)
+
+    from jax.experimental.shard_map import shard_map as _legacy
+
+    if axis_names is not None:
+        manual = set(axis_names)
+        all_axes = set(mesh.axis_names)
+        unknown = manual - all_axes
+        if unknown:
+            raise ValueError(
+                f"axis_names {sorted(unknown)} not in mesh axes "
+                f"{mesh.axis_names}")
+        auto = all_axes - manual
+        if auto:
+            key = (tuple(sorted(manual)), tuple(mesh.axis_names),
+                   tuple(mesh.devices.shape))
+            if key not in _PARTIAL_MANUAL_WARNED:
+                _PARTIAL_MANUAL_WARNED.add(key)
+                warnings.warn(
+                    f"[orion-tpu compat] shard_map(axis_names="
+                    f"{sorted(manual)}) on mesh axes "
+                    f"{mesh.axis_names}: jax {jax.__version__} cannot "
+                    "lower manual collectives under partial-auto "
+                    "shard_map; degrading to fully-manual (auto axes "
+                    f"{sorted(auto)} replicate inside the mapped body)",
+                    RuntimeWarning, stacklevel=2)
+    return _legacy(f, mesh=mesh, in_specs=in_specs,
+                   out_specs=out_specs,
+                   check_rep=bool(rep) if rep is not None else True)
 
 
 def enable_compile_cache(path: str = "/tmp/jax_cache",

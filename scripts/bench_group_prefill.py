@@ -110,6 +110,7 @@ def run(eng, params, prompts, lens, tag):
         t0 = time.perf_counter()
         out = eng.generate_batch(prompts, lens, jax.random.key(r + 1),
                                  params=params, group_size=K)
+        jax.block_until_ready(out.completions)
         times.append(time.perf_counter() - t0)
         pre.append(acc["s"])
         assert out.completions.shape[0] == B * K

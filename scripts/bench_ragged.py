@@ -99,7 +99,8 @@ def main():
         reqs = [(i, prompts[i], int(budgets[i])) for i in range(N_REQ)]
         out = cont.generate(reqs, jax.random.key(1))
         assert len(out) == N_REQ
-        return time.perf_counter() - t0
+        # cont.generate drains every request to host before returning
+        return time.perf_counter() - t0  # orion: ignore[bench-no-block]
 
     for name, budgets in [("uniform", np.full(N_REQ, T, np.int32)),
                           ("ragged ", budgets_ragged(rs))]:

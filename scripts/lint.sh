@@ -1,0 +1,16 @@
+#!/usr/bin/env bash
+# Pre-PR gate: the orion_tpu.analysis static-analysis suite over the
+# whole tree.  Nonzero exit on any unsuppressed finding — run this
+# before every PR (tests/test_analysis.py enforces the same cleanliness
+# in tier-1, so a dirty tree fails CI either way).
+#
+#   bash scripts/lint.sh            # analyze the default tree
+#   bash scripts/lint.sh mydir/     # analyze something else
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+if [ "$#" -gt 0 ]; then
+    exec python -m orion_tpu.analysis "$@"
+fi
+exec python -m orion_tpu.analysis orion_tpu tests scripts bench.py \
+    __graft_entry__.py

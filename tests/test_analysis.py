@@ -1,0 +1,632 @@
+"""orion_tpu.analysis: rule fixtures (one positive + one negative per
+rule), suppression, the CLI exit code, the runtime guards — and the
+self-gate: the engine over the shipped tree must report ZERO
+unsuppressed findings, so every future PR keeps the repo lint-clean.
+
+Named test_analysis.py deliberately: it sorts early in tier-1 and the
+whole file is AST-only except the two runtime-guard tests, so the gate
+costs seconds.
+"""
+
+import os
+import logging
+import subprocess
+import sys
+import textwrap
+import warnings
+
+import pytest
+
+from orion_tpu.analysis import (RULES, analyze_paths, analyze_source,
+                                format_findings)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def ids_of(findings):
+    return {f.rule_id for f in findings}
+
+
+def run_on(snippet: str, path: str = "x.py"):
+    return analyze_source(textwrap.dedent(snippet), path)
+
+
+# ---------------------------------------------------------------------------
+# per-rule fixtures: (rule-id, fires, clean, path)
+# ---------------------------------------------------------------------------
+
+FIXTURES = [
+    (
+        "compat-import",
+        """
+        from jax import shard_map
+        """,
+        """
+        from orion_tpu.utils.platform import axis_size, shard_map
+        """,
+        "x.py",
+    ),
+    (
+        "compat-import",
+        """
+        from jax import lax
+
+        def f(x):
+            return lax.axis_size("seq")
+        """,
+        """
+        from orion_tpu.utils.platform import axis_size
+
+        def f(x):
+            return axis_size("seq")
+        """,
+        "x.py",
+    ),
+    (
+        "host-sync-in-jit",
+        """
+        import jax
+
+        @jax.jit
+        def f(x):
+            return x.sum().item()
+        """,
+        """
+        import jax
+
+        @jax.jit
+        def f(x):
+            return x.sum()
+
+        def fetch(x):
+            return f(x).item()  # host side: fine
+        """,
+        "x.py",
+    ),
+    (
+        "host-sync-in-jit",
+        """
+        import functools
+        import jax
+        import jax.numpy as jnp
+
+        @functools.partial(jax.jit, static_argnums=(1,))
+        def f(x, n):
+            return float(jnp.mean(x)) * n
+        """,
+        """
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def f(x, scale: float):
+            return jnp.mean(x) * float(scale)
+        """,
+        "x.py",
+    ),
+    (
+        "host-sync-in-jit",
+        """
+        import jax
+        import numpy as np
+
+        def outer(x):
+            def body(c, _):
+                return np.asarray(c), None
+            return jax.lax.scan(body, x, None, length=3)
+        """,
+        """
+        import jax
+        import jax.numpy as jnp
+
+        def outer(x):
+            def body(c, _):
+                return jnp.asarray(c), None
+            return jax.lax.scan(body, x, None, length=3)
+        """,
+        "x.py",
+    ),
+    (
+        "host-sync-in-jit",
+        """
+        import jax
+
+        def outer(x, n):
+            def body(i, c):
+                return c + c.sum().item()
+            return jax.lax.fori_loop(0, n, body, x)
+        """,
+        """
+        import jax
+
+        def scan_user(x):
+            def body(c, _):
+                return c * 2, None
+            return jax.lax.scan(body, x, None, length=3)
+
+        def host_helper(results):
+            def body(r):
+                return r.sum().item()  # host side, own scope's 'body'
+            return [body(r) for r in results]
+        """,
+        "x.py",
+    ),
+    (
+        "impure-in-jit",
+        """
+        import jax
+
+        def outer(x):
+            def cond(c):
+                return c.sum() < 10
+
+            def body(c):
+                print("trace me not", c)
+                return c + 1
+            return jax.lax.while_loop(cond, body, x)
+        """,
+        """
+        import jax
+
+        def outer(x):
+            def cond(c):
+                return c.sum() < 10
+
+            def body(c):
+                return c + 1
+            out = jax.lax.while_loop(cond, body, x)
+            print("host side:", out)
+            return out
+        """,
+        "x.py",
+    ),
+    (
+        "prng-reuse",
+        """
+        import jax
+
+        def sample(key):
+            a = jax.random.normal(key, (2,))
+            b = jax.random.uniform(key, (2,))
+            return a + b
+        """,
+        """
+        import jax
+
+        def sample(key):
+            key, sub = jax.random.split(key)
+            a = jax.random.normal(sub, (2,))
+            key, sub = jax.random.split(key)
+            b = jax.random.uniform(sub, (2,))
+            return a + b
+        """,
+        "x.py",
+    ),
+    (
+        "prng-reuse",
+        """
+        import jax
+
+        def loop(rng, n):
+            out = []
+            for _ in range(n):
+                out.append(jax.random.normal(rng, (2,)))
+            return out
+        """,
+        """
+        import jax
+
+        def loop(rng, n):
+            out = []
+            for i in range(n):
+                sub = jax.random.fold_in(rng, i)
+                out.append(jax.random.normal(sub, (2,)))
+            return out
+        """,
+        "x.py",
+    ),
+    (
+        "impure-in-jit",
+        """
+        import jax
+
+        @jax.jit
+        def f(x):
+            print("value:", x)
+            return x
+        """,
+        """
+        import jax
+
+        @jax.jit
+        def f(x):
+            jax.debug.print("value: {}", x)
+            return x
+        """,
+        "x.py",
+    ),
+    (
+        "impure-in-jit",
+        """
+        import time
+        import jax
+
+        @jax.jit
+        def f(x):
+            return x * time.time()
+        """,
+        """
+        import time
+        import jax
+
+        @jax.jit
+        def f(x):
+            return x * 2
+
+        def bench(x):
+            t0 = time.time()
+            return f(x), time.time() - t0
+        """,
+        "x.py",
+    ),
+    (
+        "traced-branch",
+        """
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def f(x):
+            if jnp.any(x > 0):
+                return x
+            return -x
+        """,
+        """
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def f(x, *, causal: bool = True):
+            if causal:
+                x = jnp.tril(x)
+            return jnp.where(jnp.any(x > 0), x, -x)
+        """,
+        "x.py",
+    ),
+    (
+        "mutable-default",
+        """
+        def collect(x, acc=[]):
+            acc.append(x)
+            return acc
+        """,
+        """
+        def collect(x, acc=None):
+            acc = [] if acc is None else acc
+            acc.append(x)
+            return acc
+        """,
+        "x.py",
+    ),
+    (
+        "mutable-default",
+        """
+        import dataclasses
+
+        @dataclasses.dataclass
+        class Cfg:
+            layers: object = []
+        """,
+        """
+        import dataclasses
+
+        @dataclasses.dataclass
+        class Cfg:
+            layers: object = dataclasses.field(default_factory=list)
+        """,
+        "x.py",
+    ),
+    (
+        "donated-reuse",
+        """
+        import jax
+
+        def run(step, state, batch):
+            step2 = jax.jit(step, donate_argnums=(0,))
+            out = step2(state, batch)
+            return out, state
+        """,
+        """
+        import jax
+
+        def run(step, state, batch):
+            step2 = jax.jit(step, donate_argnums=(0,))
+            state = step2(state, batch)
+            return state
+        """,
+        "x.py",
+    ),
+    (
+        "bench-no-block",
+        """
+        import time
+
+        def bench(f, x):
+            t0 = time.perf_counter()
+            y = f(x)
+            return y, time.perf_counter() - t0
+        """,
+        """
+        import time
+        import jax
+
+        def bench(f, x):
+            t0 = time.perf_counter()
+            y = jax.block_until_ready(f(x))
+            return y, time.perf_counter() - t0
+        """,
+        "bench_fake.py",
+    ),
+    (
+        "bench-no-block",
+        """
+        import time
+
+        def bench(f, x):
+            t0 = time.time()
+            for _ in range(8):
+                y = f(x)
+            return time.time() - t0
+        """,
+        """
+        import time
+        import numpy as np
+
+        def bench(f, x):
+            t0 = time.time()
+            for _ in range(8):
+                y = np.asarray(f(x))
+            return time.time() - t0
+        """,
+        "bench_fake.py",
+    ),
+]
+
+
+@pytest.mark.parametrize(
+    "rule_id,pos,neg,path",
+    FIXTURES,
+    ids=[f"{r}-{i}" for i, (r, *_rest) in enumerate(FIXTURES)])
+def test_rule_fixtures(rule_id, pos, neg, path):
+    hits = run_on(pos, path)
+    assert rule_id in ids_of(hits), \
+        f"positive fixture did not fire {rule_id}"
+    assert all(f.hint for f in hits if f.rule_id == rule_id), \
+        "every finding carries a fix hint"
+    assert rule_id not in ids_of(run_on(neg, path)), \
+        f"negative fixture wrongly fired {rule_id}"
+
+
+def test_every_rule_has_fixture_coverage():
+    covered = {r for r, *_ in FIXTURES}
+    assert covered == {r.id for r in RULES}, \
+        "each registered rule needs a positive+negative fixture here"
+    assert len(RULES) >= 8
+
+
+# ---------------------------------------------------------------------------
+# suppression + report format
+# ---------------------------------------------------------------------------
+
+SUPPRESSIBLE = """
+import jax
+
+@jax.jit
+def f(x):
+    return x.sum().item()  # orion: ignore[host-sync-in-jit] eager debug
+"""
+
+
+def test_suppression_comment_silences_the_line():
+    assert run_on(SUPPRESSIBLE) == []
+
+
+def test_suppression_requires_matching_rule_id():
+    wrong = SUPPRESSIBLE.replace("host-sync-in-jit", "prng-reuse")
+    assert "host-sync-in-jit" in ids_of(run_on(wrong))
+
+
+def test_bare_suppression_silences_every_rule():
+    bare = SUPPRESSIBLE.replace("ignore[host-sync-in-jit] eager debug",
+                                "ignore")
+    assert run_on(bare) == []
+
+
+def test_report_format_has_file_line_and_hint():
+    findings = run_on(SUPPRESSIBLE.replace("  # orion: ignore"
+                                           "[host-sync-in-jit] eager "
+                                           "debug", ""), "mod.py")
+    text = format_findings(findings)
+    assert "mod.py:6:" in text
+    assert "[host-sync-in-jit]" in text
+    assert "hint:" in text
+
+
+def test_syntax_error_reports_instead_of_crashing():
+    bad = run_on("def f(:\n")
+    assert [f.rule_id for f in bad] == ["syntax-error"]
+
+
+# ---------------------------------------------------------------------------
+# CLI exit codes
+# ---------------------------------------------------------------------------
+
+
+def _run_cli(*args):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    return subprocess.run(
+        [sys.executable, "-m", "orion_tpu.analysis", *args],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=120)
+
+
+def test_cli_exit_codes(tmp_path):
+    dirty = tmp_path / "dirty.py"
+    dirty.write_text("from jax import shard_map\n")
+    clean = tmp_path / "clean.py"
+    clean.write_text("from orion_tpu.utils.platform import shard_map\n")
+
+    r = _run_cli(str(dirty))
+    assert r.returncode == 1, r.stderr
+    assert "dirty.py:1:" in r.stdout and "compat-import" in r.stdout
+
+    r = _run_cli(str(clean))
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert r.stdout == ""
+
+
+def test_cli_missing_path_errors(tmp_path, capsys):
+    from orion_tpu.analysis.__main__ import main
+
+    assert main([str(tmp_path / "renamed_away.py")]) == 2
+    assert "renamed_away.py" in capsys.readouterr().err
+
+
+def test_cli_rule_filter_and_listing(tmp_path, capsys):
+    from orion_tpu.analysis.__main__ import main
+
+    dirty = tmp_path / "dirty.py"
+    dirty.write_text("from jax import shard_map\n")
+    assert main(["--rule", "prng-reuse", str(dirty)]) == 0
+    assert main([str(dirty)]) == 1
+    assert main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for rl in RULES:
+        assert rl.id in out
+
+
+# ---------------------------------------------------------------------------
+# the self-gate: the shipped tree stays clean
+# ---------------------------------------------------------------------------
+
+
+def test_repo_package_is_clean():
+    findings = analyze_paths([os.path.join(REPO, "orion_tpu")])
+    assert findings == [], "\n" + format_findings(findings)
+
+
+def test_repo_scripts_and_tests_are_clean():
+    findings = analyze_paths([
+        os.path.join(REPO, "scripts"),
+        os.path.join(REPO, "tests"),
+        os.path.join(REPO, "bench.py"),
+        os.path.join(REPO, "__graft_entry__.py"),
+    ])
+    assert findings == [], "\n" + format_findings(findings)
+
+
+def test_gate_catches_a_seeded_violation(tmp_path):
+    scratch = tmp_path / "scratch.py"
+    scratch.write_text(textwrap.dedent("""
+        import jax
+
+        @jax.jit
+        def step(x):
+            return x.sum().item()
+    """))
+    findings = analyze_paths([str(tmp_path)])
+    assert any(f.rule_id == "host-sync-in-jit" and f.line == 6
+               for f in findings), format_findings(findings)
+
+
+# ---------------------------------------------------------------------------
+# runtime guards
+# ---------------------------------------------------------------------------
+
+
+def test_recompile_sentinel_counts_and_warns():
+    import jax
+    import jax.numpy as jnp
+
+    from orion_tpu.analysis.runtime_guards import RecompileSentinel
+
+    sentinel = RecompileSentinel(budget=1).install()
+    try:
+        @jax.jit
+        def poly_fn_for_sentinel(x):
+            return x * 2 + 1
+
+        with warnings.catch_warnings(record=True) as caught:
+            warnings.simplefilter("always")
+            for n in (3, 4, 5):  # three shapes => three compiles
+                poly_fn_for_sentinel(jnp.ones((n,)))
+        assert sentinel.counts.get("poly_fn_for_sentinel", 0) >= 2
+        assert sentinel.total_compiles >= 2
+        msgs = [str(w.message) for w in caught
+                if issubclass(w.category, RuntimeWarning)]
+        assert any("recompile sentinel" in m
+                   and "poly_fn_for_sentinel" in m for m in msgs), msgs
+    finally:
+        sentinel.uninstall()
+    assert not jax.config.jax_log_compiles
+
+
+def test_stacked_sentinels_restore_log_compiles():
+    """Two live sentinels: the LAST uninstall restores the ORIGINAL
+    jax_log_compiles (a per-sentinel snapshot would capture the first
+    install's True and leak it forever)."""
+    import jax
+
+    from orion_tpu.analysis.runtime_guards import RecompileSentinel
+
+    orig = bool(jax.config.jax_log_compiles)
+    a = RecompileSentinel(budget=3).install()
+    b = RecompileSentinel(budget=3).install()
+    a.uninstall()
+    assert jax.config.jax_log_compiles  # b still live
+    b.uninstall()
+    assert bool(jax.config.jax_log_compiles) == orig
+    handlers = logging.getLogger("jax").handlers
+    assert a not in handlers and b not in handlers
+
+
+def test_trainer_close_uninstalls_sentinel():
+    from orion_tpu.analysis.runtime_guards import _active_sentinels
+    from orion_tpu.config import TrainConfig
+    from orion_tpu.trainers.base import BaseTrainer
+
+    class _Shell:
+        close = BaseTrainer.close
+
+    shell = _Shell()
+    from orion_tpu.analysis.runtime_guards import install_from_config
+    shell._recompile_sentinel = install_from_config(
+        TrainConfig(recompile_budget=2))
+    assert shell._recompile_sentinel in _active_sentinels
+    shell.close()
+    assert shell._recompile_sentinel is None
+    shell.close()  # idempotent
+
+
+def test_guard_scope_wires_transfer_guard():
+    import jax
+
+    from orion_tpu.analysis.runtime_guards import guard_scope
+
+    before = jax.config.jax_transfer_guard
+    with guard_scope("log"):
+        assert jax.config.jax_transfer_guard == "log"
+    assert jax.config.jax_transfer_guard == before
+    with guard_scope(None):  # no-op path
+        assert jax.config.jax_transfer_guard == before
+
+
+def test_install_from_config_respects_budget():
+    from orion_tpu.analysis.runtime_guards import install_from_config
+    from orion_tpu.config import TrainConfig
+
+    assert install_from_config(TrainConfig()) is None
+    sentinel = install_from_config(TrainConfig(recompile_budget=5))
+    try:
+        assert sentinel is not None and sentinel.budget == 5
+    finally:
+        sentinel.uninstall()

@@ -9,9 +9,9 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
 
 from orion_tpu.config import MeshConfig
+from orion_tpu.utils.platform import shard_map
 from orion_tpu.ops.attention import reference_attention, repeat_kv
 from orion_tpu.parallel.longctx import (ring_attention, ulysses_attention,
                                         zigzag_inverse, zigzag_order)
@@ -246,8 +246,9 @@ def test_ring_matches_reference_ring():
     from orion_tpu.parallel.longctx import (ring_attention,
                                             ring_attention_reference,
                                             zigzag_order)
-    from jax import shard_map
     from jax.sharding import PartitionSpec as P
+
+    from orion_tpu.utils.platform import shard_map
     from orion_tpu.parallel.mesh import make_mesh
     from orion_tpu.config import MeshConfig
 
