@@ -777,3 +777,61 @@ def _check_bench_no_block(ctx: ModuleContext):
     scan_scope(ctx.tree.body)
     for f in findings:
         yield f
+
+
+# ---------------------------------------------------------------------------
+# rule: unsupervised-thread
+# ---------------------------------------------------------------------------
+
+
+@rule("unsupervised-thread",
+      "threading.Thread started in orion_tpu/ library code without "
+      "watchdog registration — a crashed or stalled worker is "
+      "invisible to the supervisor")
+def _check_unsupervised_thread(ctx: ModuleContext):
+    # Library code only: tests/ and scripts/ spawn throwaway threads
+    # whose lifetime the test harness already bounds.
+    p = ctx.path.replace(os.sep, "/")
+    if "orion_tpu/" not in p:
+        return
+
+    # innermost enclosing function for every node (ast.walk is BFS, so
+    # outer functions are visited first and inner assignments win)
+    scope_of: Dict[int, Optional[ast.AST]] = {}
+    functions = [n for n in ctx.walk()
+                 if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+    for fn in functions:
+        for sub in ast.walk(fn):
+            scope_of[id(sub)] = fn
+
+    def supervised(scope: Optional[ast.AST]) -> bool:
+        """The scope (or, for module level, the module's top-level
+        statements) contains a watchdog-flavored call — e.g.
+        ``self.watchdog.register(...)`` / ``Watchdog().register``."""
+        if scope is None:
+            nodes = [n for n in ctx.walk()
+                     if scope_of.get(id(n)) is None]
+        else:
+            nodes = list(ast.walk(scope))
+        for sub in nodes:
+            if isinstance(sub, ast.Call):
+                d = ctx.dotted(sub.func)
+                if d and "watchdog" in d.lower():
+                    return True
+        return False
+
+    for node in ctx.walk():
+        if not isinstance(node, ast.Call):
+            continue
+        if ctx.dotted(node.func) != "threading.Thread":
+            continue
+        if supervised(scope_of.get(id(node))):
+            continue
+        yield Finding(
+            "unsupervised-thread", ctx.path, node.lineno,
+            "threading.Thread started without watchdog registration "
+            "in its scope",
+            hint="register a heartbeat with orion_tpu.resilience."
+                 "Watchdog in the spawning function (see "
+                 "AsyncOrchestrator._spawn_worker), or justify with "
+                 "# orion: ignore[unsupervised-thread]")

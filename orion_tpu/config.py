@@ -349,6 +349,64 @@ class DataConfig:
 
 
 @dataclass
+class ResilienceConfig:
+    """Fault handling for the whole stack (orion_tpu.resilience).
+
+    Defaults are the legacy fail-fast semantics everywhere except
+    checkpoint saves (retried — a transient filesystem hiccup should
+    never lose a step) and non-finite quarantine (a NaN score must
+    never be donated into the optimizer).  Turn on the supervisor with
+    ``max_rollout_restarts`` / ``degrade_to_sync`` for long unattended
+    runs.
+    """
+
+    # -- supervised rollout recovery (AsyncOrchestrator) ---------------
+    # Restart budget for a crashed/stalled rollout worker; each restart
+    # re-syncs weights.  0 = fail fast (legacy behavior).
+    max_rollout_restarts: int = 0
+    # Past the restart budget: degrade to synchronous rollout on the
+    # train mesh (run completes, slower) instead of raising.
+    degrade_to_sync: bool = False
+    # Seconds without a rollout-worker heartbeat before the supervisor
+    # declares a stall (0 = stall detection off; crash detection is
+    # always on).
+    heartbeat_timeout: float = 0.0
+    # Skip (+ count) dequeued batches whose scores/logprobs contain
+    # non-finite values instead of feeding them to the update step.
+    quarantine_nonfinite: bool = True
+    # -- retries -------------------------------------------------------
+    reward_attempts: int = 1        # reward_fn call attempts
+    weight_sync_attempts: int = 1   # learner→rollout broadcast attempts
+    checkpoint_save_attempts: int = 3
+    # Deadline (s) for CheckpointManager.wait(); 0 = wait forever.
+    checkpoint_wait_deadline: float = 0.0
+    # -- shared backoff shape (RetryPolicy) ----------------------------
+    backoff_base: float = 0.05
+    backoff_multiplier: float = 2.0
+    backoff_max: float = 2.0
+    backoff_jitter: float = 0.1
+    # -- deterministic chaos (orion_tpu.resilience.inject) -------------
+    # Fault-plan spec string, e.g. "rollout.generate:at=4+5;
+    # checkpoint.save:p=0.25,times=2"; armed at trainer construction.
+    # The ORION_FAULT_PLAN env var arms the same thing with no code.
+    fault_plan: Optional[str] = None
+    fault_seed: int = 0
+
+    def retry_policy(self, max_attempts: int, seed: int = 0):
+        """A :class:`~orion_tpu.resilience.RetryPolicy` carrying this
+        config's shared backoff shape — the one constructor every
+        retry site (reward calls, weight sync) goes through, so a new
+        backoff field propagates everywhere at once."""
+        from orion_tpu.resilience import RetryPolicy
+
+        return RetryPolicy(
+            max_attempts=max_attempts, base_delay=self.backoff_base,
+            multiplier=self.backoff_multiplier,
+            max_delay=self.backoff_max, jitter=self.backoff_jitter,
+            seed=seed)
+
+
+@dataclass
 class TrainConfig:
     """Common trainer settings shared by all algorithms."""
 
@@ -415,6 +473,9 @@ class TrainConfig:
     # compiles more than this many times (0 disables the sentinel).
     transfer_guard: Optional[str] = None
     recompile_budget: int = 0
+    # Fault handling (orion_tpu.resilience): supervisor budgets,
+    # retries, quarantine, and the deterministic fault-injection plan.
+    resilience: ResilienceConfig = field(default_factory=ResilienceConfig)
 
 
 @dataclass

@@ -23,15 +23,31 @@ distribution* logprobs (temperature/top-k/top-p applied — the
 distribution the tokens were actually drawn from) as ``old_logprobs``
 (``cfg.async_mode=True`` — see ``BaseTrainer.behavior_logprobs``) so
 PPO-family clipped ratios carry the staleness correction unbiased.
+
+Supervised recovery (orion_tpu.resilience, SURVEY.md §5): the rollout
+worker publishes heartbeats to a :class:`Watchdog`; the learner loop
+doubles as the supervisor.  A crashed (or, with
+``resilience.heartbeat_timeout``, stalled) worker is restarted with a
+fresh weight sync up to ``resilience.max_rollout_restarts`` times; past
+the budget the orchestrator either raises (legacy fail-fast, the
+default) or — with ``resilience.degrade_to_sync`` — degrades gracefully
+to synchronous rollout on the train mesh so the run completes slower
+instead of deadlocking.  Dequeued batches carrying non-finite scores or
+behavior logprobs are quarantined (skipped + counted), never donated
+into the optimizer.  Every recovery decision lands in ``self.events``
+(a deterministic sequence under a seeded FaultPlan) and in the metrics
+stream.
 """
 
 from __future__ import annotations
 
 import dataclasses
+import logging
 import queue
+import sys
 import threading
 import time
-from typing import Any, Iterator, Optional, Sequence
+from typing import Any, Iterator, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -39,8 +55,11 @@ import numpy as np
 
 from orion_tpu.models.sharded import mesh_shardings_for
 from orion_tpu.parallel.mesh import make_mesh
-from orion_tpu.config import MeshConfig
+from orion_tpu.config import MeshConfig, ResilienceConfig
+from orion_tpu.resilience import Heartbeat, Watchdog, fault_point
 from orion_tpu.trainers.base import BaseTrainer
+
+_LOG = logging.getLogger(__name__)
 
 
 def split_devices(devices: Sequence, n_rollout: int) -> tuple:
@@ -114,11 +133,41 @@ class AsyncOrchestrator:
         self._rollout_shardings = mesh_shardings_for(
             trainer.model, self.rollout_mesh, init_args)
 
+        self._rollout_devices = list(rollout_devices)
         # A second engine instance bound to the rollout group; the
         # trainer's own (sync) engine is left untouched.  Honors
         # cfg.rollout.engine (VERDICT r2 missing #4: "continuous" was
         # silently ignored and the async path trained on the simple
         # engine with no warning).
+        self.engine = self._build_engine()
+
+        self._queue: queue.Queue = queue.Queue(maxsize=staleness)
+        self._weights_lock = threading.Lock()
+        self._version_cv = threading.Condition()
+        self._rollout_error: Optional[BaseException] = None
+        self._version = 0
+        # Supervision state (orion_tpu.resilience): the learner loop is
+        # the supervisor; these are its instruments.
+        self.rcfg: ResilienceConfig = (
+            getattr(trainer.cfg, "resilience", None) or ResilienceConfig())
+        self.watchdog = Watchdog()
+        self.events: list = []   # (kind, detail) recovery log, in order
+        self.recovery = {"rollout_restarts": 0, "quarantined_batches": 0,
+                         "degraded_iterations": 0}
+        self._incarnation = 0    # rollout-worker generation counter
+        self._abandoned: list = []  # stalled threads we cannot join
+        self._produced = 0       # batches enqueued by the current run
+        self._broadcast_weights()  # version 0: initial policy
+        self._rng = jax.random.key(trainer.cfg.seed + 7919)
+
+    def _build_engine(self):
+        """The rollout group's engine.  Also called by ``_recover``
+        when a stalled (still-alive) incarnation is abandoned
+        mid-dispatch: the wedged thread keeps its old engine object and
+        the replacement worker gets a fresh one — two threads must
+        never share mutable engine state (page pools, prepped params)."""
+        trainer = self.trainer
+        eng_kind = trainer.cfg.rollout.engine
         if eng_kind == "continuous":
             from orion_tpu.rollout.continuous import \
                 ContinuousBatchingEngine
@@ -127,32 +176,22 @@ class AsyncOrchestrator:
             # lead device; pools/params carry explicit rollout-mesh
             # shardings (the engine's mesh) so the learner mesh never
             # hosts them and the full group is actually used.
-            with jax.default_device(rollout_devices[0]):
-                self.engine = ContinuousBatchingEngine(
+            with jax.default_device(self._rollout_devices[0]):
+                return ContinuousBatchingEngine(
                     trainer.model, trainer.cfg.model, trainer.cfg.rollout,
                     eos_token_id=trainer.engine.eos,
                     pad_token_id=trainer.engine.pad,
                     mesh=self.rollout_mesh)
-        elif eng_kind == "simple":
+        if eng_kind == "simple":
             from orion_tpu.rollout import RolloutEngine
 
-            self.engine = RolloutEngine(
+            return RolloutEngine(
                 trainer.model, trainer.cfg.model, trainer.cfg.rollout,
                 eos_token_id=trainer.engine.eos_token_id,
                 pad_token_id=trainer.engine.pad_token_id)
-        else:
-            raise ValueError(
-                f"async orchestrator: unknown rollout.engine "
-                f"{eng_kind!r} (expected 'simple' or 'continuous')")
-
-        self._queue: queue.Queue = queue.Queue(maxsize=staleness)
-        self._weights_lock = threading.Lock()
-        self._version_cv = threading.Condition()
-        self._stop = threading.Event()
-        self._rollout_error: Optional[BaseException] = None
-        self._version = 0
-        self._broadcast_weights()  # version 0: initial policy
-        self._rng = jax.random.key(trainer.cfg.seed + 7919)
+        raise ValueError(
+            f"async orchestrator: unknown rollout.engine "
+            f"{eng_kind!r} (expected 'simple' or 'continuous')")
 
     # ------------------------------------------------------------------
     # weight-sync channel (SURVEY.md §2 #11)
@@ -175,26 +214,44 @@ class AsyncOrchestrator:
         flagship config, 16 GB after this cast.  Numerics are
         unchanged: int8 engine quantization already started from the
         compute-dtype copy."""
-        params = self.trainer.state.params
-        cdt = jnp.dtype(self.trainer.cfg.model.dtype)
-        if cdt != jnp.dtype(self.trainer.cfg.model.param_dtype):
-            if not hasattr(self, "_jit_bcast_cast"):
-                self._jit_bcast_cast = jax.jit(lambda p: jax.tree.map(
-                    lambda x: x.astype(cdt)
-                    if jnp.issubdtype(x.dtype, jnp.floating) else x, p))
-            params = self._jit_bcast_cast(params)
-        snapshot = jax.device_put(params, self._rollout_shardings)
-        with self._weights_lock:
-            self._rollout_params = snapshot
+
+        def _sync() -> None:
+            fault_point("weight_sync")
+            params = self.trainer.state.params
+            cdt = jnp.dtype(self.trainer.cfg.model.dtype)
+            if cdt != jnp.dtype(self.trainer.cfg.model.param_dtype):
+                if not hasattr(self, "_jit_bcast_cast"):
+                    self._jit_bcast_cast = jax.jit(lambda p: jax.tree.map(
+                        lambda x: x.astype(cdt)
+                        if jnp.issubdtype(x.dtype, jnp.floating) else x, p))
+                params = self._jit_bcast_cast(params)
+            snapshot = jax.device_put(params, self._rollout_shardings)
+            with self._weights_lock:
+                self._rollout_params = snapshot
+
+        if self.rcfg.weight_sync_attempts > 1:
+            self.rcfg.retry_policy(
+                self.rcfg.weight_sync_attempts,
+                seed=self.trainer.cfg.seed).call(
+                    _sync, on_retry=lambda a, e, d: self._event(
+                        "weight_sync_retry", a))
+        else:
+            _sync()
 
     # ------------------------------------------------------------------
     # rollout worker (host thread driving the rollout device group)
     # ------------------------------------------------------------------
     def _rollout_loop(self, prompt_iter: Iterator[dict],
-                      n_batches: int, base_version: int) -> None:
+                      n_batches: int, base_version: int,
+                      stop: threading.Event, hb: Heartbeat) -> None:
+        """One worker incarnation.  ``stop``/``hb`` are THIS
+        incarnation's flag and heartbeat — a stalled incarnation the
+        supervisor abandoned may wake up later, see its own (set) flag,
+        and exit without touching its replacement's state."""
         try:
             for i in range(n_batches):
-                if self._stop.is_set():
+                hb.beat()
+                if stop.is_set():
                     return
                 # Strict staleness gate: batch i of this run is trained
                 # at learner version base+i, so generating it with
@@ -204,9 +261,10 @@ class AsyncOrchestrator:
                 # queue.
                 needed = base_version + i - self.staleness
                 with self._version_cv:
-                    while self._version < needed and not self._stop.is_set():
+                    while self._version < needed and not stop.is_set():
                         self._version_cv.wait(timeout=0.1)
-                if self._stop.is_set():
+                        hb.beat()
+                if stop.is_set():
                     return
                 batch = next(prompt_iter)
                 # Iterator-cursor snapshot taken HERE, on the only
@@ -219,7 +277,15 @@ class AsyncOrchestrator:
                 with self._weights_lock:
                     params = self._rollout_params
                     version = self._version
+                # Last gate before the dispatch: an incarnation the
+                # supervisor abandoned while it was stalled UPSTREAM of
+                # here (prompt iterator, prepare) must not wake up and
+                # dispatch on the rebuilt engine or split the shared rng
+                # concurrently with its replacement.
+                if stop.is_set():
+                    return
                 self._rng, sub = jax.random.split(self._rng)
+                hb.beat()  # entering the long device dispatch
                 if hasattr(self.engine, "generate_batch"):
                     # continuous engine: request-stream admission loop
                     # behind the same batched contract.  Group trainers
@@ -238,21 +304,169 @@ class AsyncOrchestrator:
                     result = self.engine.generate(
                         np.asarray(ids), np.asarray(lens), sub,
                         params=params)
+                # An incarnation abandoned (or shut down) while inside
+                # the dispatch drops its orphaned result here: scoring
+                # would race the replacement worker through the shared
+                # trainer reward path (a model-based reward's engine is
+                # as stateful as the rollout engine).
+                if stop.is_set():
+                    return
                 # Host staging: the experience crosses the group boundary
                 # as numpy (ONE batched fetch); the learner's jitted
                 # programs re-place it on the train mesh.
                 host = result.to_host()
                 scores = self.trainer._score_result(result, host, meta)
                 item = _Item(host._fields(), scores, version, data_state)
-                while not self._stop.is_set():
+                fault_point("queue.put")
+                while not stop.is_set():
                     try:
                         self._queue.put(item, timeout=0.1)
+                        self._produced += 1
                         break
                     except queue.Full:
+                        hb.beat()
                         continue
-        except BaseException as e:  # surfaced to the learner
-            self._rollout_error = e
-            self._stop.set()
+        except BaseException as e:  # surfaced to the learner/supervisor
+            if not stop.is_set():  # abandoned incarnations stay silent
+                self._rollout_error = e
+            stop.set()
+
+    def _spawn_worker(self, prompt_iter: Iterator[dict], n_batches: int,
+                      base_version: int
+                      ) -> Tuple[threading.Thread, threading.Event,
+                                 Heartbeat]:
+        """Start a rollout-worker incarnation under watchdog
+        supervision.  The thread keeps the fixed name
+        ``rollout-worker`` (leak checks key on it); the heartbeat name
+        carries the incarnation."""
+        self._incarnation += 1
+        stop = threading.Event()
+        hb = self.watchdog.register(
+            f"rollout-worker-{self._incarnation}",
+            timeout=self.rcfg.heartbeat_timeout)
+        worker = threading.Thread(
+            target=self._rollout_loop,
+            args=(prompt_iter, n_batches, base_version, stop, hb),
+            name="rollout-worker", daemon=True)
+        worker.start()
+        return worker, stop, hb
+
+    # ------------------------------------------------------------------
+    # supervisor (runs on the learner thread)
+    # ------------------------------------------------------------------
+    def _event(self, kind: str, detail) -> None:
+        self.events.append((kind, detail))
+
+    def _worker_failure(self, worker: threading.Thread, hb: Heartbeat,
+                        n_total: int) -> Optional[str]:
+        """Failure kind for the current incarnation, or None if
+        healthy.  Queued items from a crashed worker stay consumable —
+        death is only declared once the queue has drained, so already-
+        generated experience is trained (and the restart offset math
+        sees consumed == produced), never dropped."""
+        if self._rollout_error is not None:
+            if self._queue.empty():
+                return "crash"
+            return None  # drain the consumable backlog first
+        if not worker.is_alive() and self._queue.empty() and \
+                self._produced < n_total:
+            return "died-silently"
+        if worker.is_alive() and hb.name in self.watchdog.stalled():
+            return "stall"
+        return None
+
+    def _recover(self, failure: str, worker: threading.Thread,
+                 stop: threading.Event, hb: Heartbeat,
+                 prompt_iter: Iterator[dict], n_total: int,
+                 base_version: int
+                 ) -> Tuple[threading.Thread, threading.Event,
+                            Heartbeat, bool]:
+        """Restart within budget; degrade to sync rollout (or raise)
+        past it.  Returns (worker, stop, hb, degraded)."""
+        stop.set()  # silence the failed incarnation wherever it is
+        err, self._rollout_error = self._rollout_error, None
+        self.watchdog.unregister(hb.name)
+        if failure != "stall":
+            worker.join(timeout=5.0)
+        if worker.is_alive():
+            # A hung thread cannot be killed in Python — abandon the
+            # daemon and remember it (the leak check in train()'s
+            # finally treats abandoned workers as already-reported).
+            # It may still be INSIDE a dispatch on the shared engine,
+            # so the replacement gets a freshly built engine: the
+            # wedged thread keeps the old object and can never race
+            # the new incarnation's page pools/params when it wakes.
+            self._abandoned.append(worker)
+            self.engine = self._build_engine()
+            _LOG.error("rollout worker (incarnation %d) %s: thread "
+                       "abandoned as a daemon; rollout engine rebuilt",
+                       self._incarnation, failure)
+        if self.recovery["rollout_restarts"] < self.rcfg.max_rollout_restarts:
+            self.recovery["rollout_restarts"] += 1
+            self._event("restart", self.recovery["rollout_restarts"])
+            _LOG.warning(
+                "rollout worker %s (%r); restart %d/%d with fresh "
+                "weight sync", failure, err,
+                self.recovery["rollout_restarts"],
+                self.rcfg.max_rollout_restarts)
+            self._broadcast_weights()  # fresh snapshot for the newcomer
+            produced = self._produced
+            worker, stop, hb = self._spawn_worker(
+                prompt_iter, n_total - produced, base_version + produced)
+            return worker, stop, hb, False
+        if self.rcfg.degrade_to_sync:
+            self._event("degrade", self.recovery["rollout_restarts"])
+            _LOG.error(
+                "rollout worker %s (%r) past the restart budget (%d); "
+                "degrading to synchronous rollout on the train mesh",
+                failure, err, self.rcfg.max_rollout_restarts)
+            return worker, stop, hb, True
+        raise RuntimeError("rollout worker died") from err
+
+    def _sync_rollout_item(self, prompt_iter: Iterator[dict]) -> _Item:
+        """Graceful-degradation rollout: generate ON THE TRAIN MESH
+        with the trainer's own engine (the rollout group's engine
+        belongs to its dead/hung thread and must not be raced).  Slower
+        — the learner stalls for each generation — but the run
+        completes, staleness drops to 0, and every degraded iteration
+        is metrics-tagged."""
+        trainer = self.trainer
+        self.recovery["degraded_iterations"] += 1
+        batch = next(prompt_iter)
+        data_state = prompt_iter.state() \
+            if hasattr(prompt_iter, "state") else None
+        ids, lens, meta = trainer.prepare_prompts(batch)
+        # The update step donates the old param buffers, so the
+        # trainer-side engine must re-sync every iteration here (in
+        # async mode nothing else calls sync_weights).
+        trainer.sync_weights()
+        self._rng, sub = jax.random.split(self._rng)
+        result = trainer.generate(
+            np.asarray(ids), np.asarray(lens), rng=sub,
+            group_size=int(getattr(trainer.cfg, "group_size", 1)))
+        host = result.to_host()
+        scores = trainer._score_result(result, host, meta)
+        return _Item(host._fields(), scores, self._version, data_state)
+
+    def _quarantine_reason(self, item: _Item) -> Optional[str]:
+        """Non-finite screen over the fields the optimizer consumes:
+        scores (reward path) and behavior logprobs (importance ratio).
+        A NaN here, donated into the update, corrupts the params for
+        every later step — skipping one batch is strictly cheaper."""
+        if not np.isfinite(np.asarray(item.scores)).all():
+            return "scores"
+        lp = item.result_host.get("logprobs")
+        if lp is not None:
+            lp = np.asarray(lp)
+            mask = item.result_host.get("completion_mask")
+            # Screen only REAL completion positions: padded tail slots
+            # may legitimately hold -inf from sampling masks.
+            bad = ~np.isfinite(lp)
+            if mask is not None:
+                bad &= np.asarray(mask, bool)
+            if bad.any():
+                return "logprobs"
+        return None
 
     # ------------------------------------------------------------------
     def train(self, prompt_iter: Iterator[dict],
@@ -277,40 +491,89 @@ class AsyncOrchestrator:
             n = num_iterations
         else:  # same resume semantics as BaseTrainer.train
             n = max(0, trainer.cfg.total_iterations - trainer.global_iter)
-        # Reset for reuse: a prior train() call leaves _stop set and may
-        # leave an undrained item behind.
-        self._stop.clear()
+        # Reset for reuse: a prior train() call leaves the stop flag set
+        # and may leave an undrained item behind.
         self._rollout_error = None
+        self._produced = 0
         while True:
             try:
                 self._queue.get_nowait()
             except queue.Empty:
                 break
-        worker = threading.Thread(
-            target=self._rollout_loop, args=(prompt_iter, n, self._version),
-            name="rollout-worker", daemon=True)
-        worker.start()
+        base0 = self._version
+        degraded = False
+        worker, stop, hb = self._spawn_worker(prompt_iter, n, base0)
         try:
             for it in range(n):
                 prof.step(it)
                 t0 = time.perf_counter()
                 item = None
                 while item is None:
-                    if self._rollout_error is not None:
-                        raise RuntimeError(
-                            "rollout worker died") from self._rollout_error
+                    if degraded:
+                        item = self._sync_rollout_item(prompt_iter)
+                        break
+                    failure = self._worker_failure(worker, hb, n)
+                    if failure is not None:
+                        worker, stop, hb, degraded = self._recover(
+                            failure, worker, stop, hb, prompt_iter, n,
+                            base0)
+                        continue
                     try:
                         item = self._queue.get(timeout=0.1)
                     except queue.Empty:
                         continue
                 t_wait = time.perf_counter() - t0
+                # Quarantine gate: non-finite scores/logprobs are never
+                # donated into the optimizer — the iteration is spent
+                # (global_iter and version still advance so the metrics
+                # step, the staleness gate, and the producer/consumer
+                # batch count stay aligned) but the update is skipped
+                # and the batch counted.  No weight re-broadcast: with
+                # no update the published snapshot is already current.
+                quarantine = None
+                if self.rcfg.quarantine_nonfinite:
+                    quarantine = self._quarantine_reason(item)
+                if quarantine is not None:
+                    self.recovery["quarantined_batches"] += 1
+                    self._event("quarantine", it)
+                    _LOG.warning(
+                        "quarantined batch at iteration %d (non-finite "
+                        "%s): update skipped", it, quarantine)
+                    trainer.global_iter += 1
+                    with self._version_cv:
+                        self._version += 1
+                        self._version_cv.notify_all()
+                    stats = {
+                        "iteration": it, "quarantined": 1.0,
+                        "staleness": self._version - 1 - item.version,
+                    }
+                    stats.update(self._recovery_stats(degraded))
+                    trainer.metrics_history.append(stats)
+                    if trainer.writer is not None:
+                        trainer.writer.write(trainer.global_iter, stats)
+                    # A quarantine landing on an eval/checkpoint
+                    # boundary must not skip it — params HAVE changed
+                    # since the previous boundary (real updates ran in
+                    # between), and a later crash would otherwise lose
+                    # a full extra checkpoint interval.
+                    if (eval_iter is not None and trainer.cfg.eval_every
+                            and trainer.global_iter
+                            % trainer.cfg.eval_every == 0):
+                        trainer.sync_weights()
+                        trainer._maybe_evaluate(eval_iter)
+                    if trainer.ckpt is not None and trainer.global_iter \
+                            % trainer.cfg.checkpoint_every == 0:
+                        trainer.save_checkpoint(data_state=item.data_state,
+                                                eval_iter=eval_iter)
+                    continue
                 result = GenerationResult(**item.result_host)
                 experience, exp_stats = trainer.build_experience(
                     result, item.scores)
                 t1 = time.perf_counter()
                 stats = trainer.update_epochs(experience)
                 trainer.global_iter += 1
-                self._broadcast_weights()
+                if not degraded:  # no consumer for the snapshot when
+                    self._broadcast_weights()  # the worker is gone
                 with self._version_cv:
                     self._version += 1
                     self._version_cv.notify_all()
@@ -332,6 +595,7 @@ class AsyncOrchestrator:
                     "time_update_s": t2 - t1,
                     "samples_per_sec": n_samples / (t2 - t0),
                 })
+                stats.update(self._recovery_stats(degraded))
                 trainer.metrics_history.append(stats)
                 if trainer.writer is not None:
                     trainer.writer.write(trainer.global_iter, stats)
@@ -347,10 +611,33 @@ class AsyncOrchestrator:
                                             eval_iter=eval_iter)
         finally:
             prof.stop()
-            self._stop.set()
-            worker.join(timeout=30.0)
+            stop.set()
+            # Leaked-thread check: a join that times out used to return
+            # silently, leaving a zombie driving the rollout mesh.
+            worker.join(timeout=1.0 if worker in self._abandoned else 30.0)
+            self.watchdog.unregister(hb.name)
+            if worker.is_alive() and worker not in self._abandoned:
+                self._event("leaked-thread", self._incarnation)
+                _LOG.error(
+                    "rollout worker leaked: thread still alive after "
+                    "stop + join timeout")
+                if sys.exc_info()[0] is None:
+                    raise RuntimeError(
+                        "rollout worker thread leaked: still alive "
+                        "after stop + 30s join")
         if trainer.ckpt is not None:
             trainer.ckpt.wait()
         if self._rollout_error is not None:
             raise RuntimeError("rollout worker died") from self._rollout_error
         return trainer.metrics_history
+
+    def _recovery_stats(self, degraded: bool) -> dict:
+        """Recovery counters tagged onto every metrics row — restart/
+        degrade/quarantine events must be visible in the stream, not
+        just in logs."""
+        return {
+            "rollout_restarts": float(self.recovery["rollout_restarts"]),
+            "quarantined_batches": float(
+                self.recovery["quarantined_batches"]),
+            "degraded_sync_rollout": 1.0 if degraded else 0.0,
+        }

@@ -28,7 +28,9 @@ they already exchange.
 
 from __future__ import annotations
 
+import os
 import pickle
+import random
 import socket
 import struct
 import time
@@ -36,6 +38,8 @@ from typing import Any, Optional
 
 import jax
 import numpy as np
+
+from orion_tpu.resilience import fault_point
 
 _LEN = struct.Struct(">Q")
 
@@ -71,9 +75,23 @@ class PyTreeChannel:
 
     @classmethod
     def connect(cls, port: int, host: str = "localhost",
-                timeout: float = 120.0) -> "PyTreeChannel":
-        """Connect to the listening peer, retrying until it is up."""
+                timeout: float = 120.0,
+                seed: Optional[int] = None) -> "PyTreeChannel":
+        """Connect to the listening peer, retrying until it is up.
+
+        Jittered exponential backoff: a fixed retry cadence from every
+        rollout process makes the listener's accept queue a thundering
+        herd on restart.  The jitter stream seeds from the PID by
+        default, so co-restarting processes desynchronize with no
+        caller plumbing; pass ``seed`` (e.g. the process rank) for a
+        deterministic schedule instead.  On deadline the TimeoutError
+        carries the *last* socket error — a bare timeout hides whether
+        the peer was down (ConnectionRefused) or the address was wrong
+        (NoRouteToHost)."""
         deadline = time.monotonic() + timeout
+        rng = random.Random(os.getpid() if seed is None else seed)
+        delay = 0.05
+        last: Optional[OSError] = None
         while True:
             try:
                 sock = socket.create_connection((host, port),
@@ -83,12 +101,20 @@ class PyTreeChannel:
                 # can legitimately spend minutes inside one compile).
                 sock.settimeout(None)
                 return cls(sock)
-            except OSError:
-                if time.monotonic() >= deadline:
-                    raise
-                time.sleep(0.1)
+            except OSError as e:
+                last = e
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise TimeoutError(
+                        f"PyTreeChannel.connect({host}:{port}) gave up "
+                        f"after {timeout:.1f}s; last socket error: "
+                        f"{last!r}") from last
+                time.sleep(min(delay * (1.0 + 0.25 * rng.random()),
+                               remaining))
+                delay = min(delay * 2.0, 2.0)
 
     def send(self, tree: Any) -> None:
+        fault_point("remote.channel")
         # Header and payload go out separately: concatenating would
         # materialize a second full copy of a multi-GB weight snapshot.
         payload = pickle.dumps(tree, protocol=pickle.HIGHEST_PROTOCOL)
@@ -96,6 +122,7 @@ class PyTreeChannel:
         self._sock.sendall(payload)
 
     def recv(self) -> Any:
+        fault_point("remote.channel")
         n = _LEN.unpack(self._recv_exact(_LEN.size))[0]
         buf = bytearray(n)
         view = memoryview(buf)

@@ -1,0 +1,34 @@
+"""orion_tpu.resilience: fault injection, supervised recovery, and
+graceful degradation for the async RLHF stack (SURVEY.md §5).
+
+- :mod:`policy` — pure-host primitives: :class:`RetryPolicy`
+  (exponential backoff + deterministic seeded jitter),
+  :class:`Watchdog` (heartbeat registry with stall detection),
+  :class:`CircuitBreaker` (open / half-open probe).
+- :mod:`inject` — the named fault-point registry and seeded
+  :class:`FaultPlan` that make chaos runs reproducible.
+
+The consumers are the async orchestrator's rollout supervisor
+(restart budget → graceful sync-rollout degradation), the hardened
+:class:`~orion_tpu.utils.checkpoint.CheckpointManager`, the remote
+channel's connect backoff, and the reward paths.
+"""
+
+from orion_tpu.resilience.inject import (  # noqa: F401
+    FAULT_POINTS,
+    FaultPlan,
+    InjectedFault,
+    active_plan,
+    clear_plan,
+    current_plan,
+    fault_point,
+    install_plan,
+    plan_from_env,
+    plan_from_spec,
+)
+from orion_tpu.resilience.policy import (  # noqa: F401
+    CircuitBreaker,
+    Heartbeat,
+    RetryPolicy,
+    Watchdog,
+)

@@ -1,0 +1,245 @@
+"""Deterministic fault injection: named fault points + seeded plans.
+
+The production code is instrumented with ``fault_point(name)`` calls at
+every boundary that can fail in the wild (generation dispatch, weight
+sync, the experience queue, checkpoint I/O, reward calls, the remote
+channel).  With no plan installed a fault point is a single global
+``None`` check — effectively free.  A chaos run installs a
+:class:`FaultPlan` (via config, env, or the :func:`active_plan` context
+manager) and the named points start raising :class:`InjectedFault` on a
+seeded, fully reproducible schedule: fire on the k-th hit (``at``),
+on every hit past the k-th (``after``), or with probability ``p`` from
+a per-point seeded stream.  The plan records every decision in
+``plan.events`` so a test can assert the exact same recovery sequence
+replays under the same (plan, seed).
+
+This replaces the hand-rolled monkeypatching that used to live in
+``tests/test_fault_injection.py`` — chaos is now a first-class,
+config-armable capability (``resilience.fault_plan`` or the
+``ORION_FAULT_PLAN`` env var, e.g.
+``ORION_FAULT_PLAN="rollout.generate:at=4;checkpoint.save:p=0.25"``).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import random
+import threading
+import zlib
+from typing import Dict, List, Mapping, Optional, Tuple
+
+#: Every instrumented boundary.  A plan naming anything else is a typo
+#: and fails fast at construction.
+FAULT_POINTS = frozenset({
+    "rollout.generate",   # engine generate dispatch (both engines)
+    "weight_sync",        # learner → rollout param broadcast
+    "queue.put",          # experience handoff into the bounded queue
+    "checkpoint.save",    # orbax save (inside the retry loop)
+    "checkpoint.restore", # orbax restore (inside the fallback walk)
+    "reward.call",        # reward_fn invocation in BaseTrainer.score
+    "remote.channel",     # PyTreeChannel send/recv
+})
+
+
+class InjectedFault(RuntimeError):
+    """Raised by an armed fault point.  Deliberately a RuntimeError
+    subclass: production retry/supervision paths must treat it exactly
+    like a real failure (that is the point of the exercise)."""
+
+    def __init__(self, point: str, hit: int):
+        super().__init__(f"injected fault at {point!r} (hit {hit})")
+        self.point = point
+        self.hit = hit
+
+
+class _PointSpec:
+    """Per-point trigger: ``at`` (exact 1-indexed hits), ``after``
+    (every hit > k), ``p`` (per-hit probability from a seeded stream),
+    ``times`` (cap on total fires; 0 = unlimited)."""
+
+    def __init__(self, point: str, at=(), after: int = 0, p: float = 0.0,
+                 times: int = 0, seed: int = 0):
+        if isinstance(at, int):
+            at = (at,)
+        self.at = frozenset(int(a) for a in at)
+        if any(a < 1 for a in self.at):
+            raise ValueError(f"{point}: 'at' hits are 1-indexed, "
+                             f"got {sorted(self.at)}")
+        if not 0.0 <= p <= 1.0:
+            raise ValueError(f"{point}: p must be in [0, 1], got {p}")
+        self.after = int(after)
+        self.p = float(p)
+        self.times = int(times)
+        # Cross-process determinism: hash() is salted per interpreter,
+        # so the per-point stream seed mixes via crc32 instead.
+        self._rng = random.Random(zlib.crc32(point.encode()) ^ seed)
+        self.fired = 0
+
+    def should_fire(self, hit: int) -> bool:
+        if self.times and self.fired >= self.times:
+            return False
+        fire = (hit in self.at or
+                (self.after and hit > self.after) or
+                (self.p > 0.0 and self._rng.random() < self.p))
+        if fire:
+            self.fired += 1
+        return fire
+
+
+class FaultPlan:
+    """A seeded chaos schedule over the named fault points.
+
+    ``spec`` maps point name → trigger kwargs (see :class:`_PointSpec`),
+    e.g. ``{"rollout.generate": {"at": (4, 5)}, "checkpoint.save":
+    {"p": 0.25, "times": 2}}``.  Thread-safe: fault points are hit from
+    the rollout worker and learner threads concurrently; hit counting
+    and event logging happen under one lock, so ``events`` is a total
+    order."""
+
+    def __init__(self, spec: Mapping[str, Mapping], seed: int = 0):
+        unknown = set(spec) - FAULT_POINTS
+        if unknown:
+            raise ValueError(
+                f"unknown fault point(s) {sorted(unknown)}; known: "
+                f"{sorted(FAULT_POINTS)}")
+        self.seed = seed
+        self._specs: Dict[str, _PointSpec] = {
+            name: (kw if isinstance(kw, _PointSpec)
+                   else _PointSpec(name, seed=seed, **dict(kw)))
+            for name, kw in spec.items()}
+        self._lock = threading.Lock()
+        self.hits: Dict[str, int] = {}
+        #: (point, hit_index) per fire, in program order — the
+        #: reproducibility witness.
+        self.events: List[Tuple[str, int]] = []
+
+    def check(self, point: str) -> None:
+        """Called by :func:`fault_point`.  Counts the hit; raises
+        :class:`InjectedFault` when the point's trigger fires."""
+        if point not in FAULT_POINTS:
+            raise ValueError(
+                f"fault_point({point!r}): not a registered fault point; "
+                f"known: {sorted(FAULT_POINTS)}")
+        with self._lock:
+            hit = self.hits.get(point, 0) + 1
+            self.hits[point] = hit
+            spec = self._specs.get(point)
+            if spec is not None and spec.should_fire(hit):
+                self.events.append((point, hit))
+                raise InjectedFault(point, hit)
+
+
+# ---------------------------------------------------------------------------
+# the process-global arming slot
+# ---------------------------------------------------------------------------
+
+_PLAN: Optional[FaultPlan] = None
+_ENV_CHECKED = False
+
+
+def install_plan(plan: FaultPlan) -> None:
+    global _PLAN
+    _PLAN = plan
+
+
+def clear_plan() -> None:
+    global _PLAN
+    _PLAN = None
+
+
+def current_plan() -> Optional[FaultPlan]:
+    return _PLAN
+
+
+@contextlib.contextmanager
+def active_plan(plan: FaultPlan):
+    """Scoped arming for tests/chaos runs: install, yield, restore.
+    A plan already armed (config/env) comes back on exit — a nested
+    scope must not silently disarm the enclosing chaos run."""
+    prev = _PLAN
+    install_plan(plan)
+    try:
+        yield plan
+    finally:
+        if prev is None:
+            clear_plan()
+        else:
+            install_plan(prev)
+
+
+def fault_point(name: str) -> None:
+    """Instrumentation hook.  No plan installed → one global load and a
+    ``None`` compare; armed → seeded, reproducible failure."""
+    global _ENV_CHECKED
+    # Snapshot the global: a concurrent clear_plan() (test teardown vs.
+    # an abandoned worker thread) must degrade to a no-op, never to an
+    # AttributeError on None between the check and the call.
+    plan = _PLAN
+    if plan is None:
+        if _ENV_CHECKED:
+            return
+        _ENV_CHECKED = True
+        plan = plan_from_env()
+        if plan is None:
+            return
+        install_plan(plan)
+    plan.check(name)
+
+
+# ---------------------------------------------------------------------------
+# spec-string parsing (config / env arming)
+# ---------------------------------------------------------------------------
+
+
+def plan_from_spec(spec: str, seed: int = 0) -> FaultPlan:
+    """Parse ``"point:key=val,key=val;point2:..."`` into a FaultPlan.
+
+    Keys: ``at`` (one hit or ``+``-joined list, e.g. ``at=4+5``),
+    ``after``, ``p``, ``times``.  Example::
+
+        rollout.generate:at=4+5;checkpoint.save:p=0.25,times=2
+    """
+    out: Dict[str, Dict] = {}
+    for entry in spec.split(";"):
+        entry = entry.strip()
+        if not entry:
+            continue
+        if ":" not in entry:
+            raise ValueError(
+                f"fault plan entry {entry!r} needs 'point:key=val[,...]'")
+        point, _, body = entry.partition(":")
+        kw: Dict = {}
+        for pair in body.split(","):
+            pair = pair.strip()
+            if not pair:
+                continue
+            if "=" not in pair:
+                raise ValueError(
+                    f"fault plan trigger {pair!r} needs key=value")
+            k, _, v = pair.partition("=")
+            k = k.strip()
+            if k == "at":
+                kw["at"] = tuple(int(x) for x in v.split("+"))
+            elif k == "after":
+                kw["after"] = int(v)
+            elif k == "p":
+                kw["p"] = float(v)
+            elif k == "times":
+                kw["times"] = int(v)
+            else:
+                raise ValueError(
+                    f"unknown fault plan key {k!r} (want at/after/p/times)")
+        out[point.strip()] = kw
+    return FaultPlan(out, seed=seed)
+
+
+def plan_from_env(environ: Optional[Mapping[str, str]] = None
+                  ) -> Optional[FaultPlan]:
+    """Build a plan from ``ORION_FAULT_PLAN`` / ``ORION_FAULT_SEED``
+    (None when unset) — the zero-code arming path for chaos CI runs."""
+    env = os.environ if environ is None else environ
+    spec = env.get("ORION_FAULT_PLAN")
+    if not spec:
+        return None
+    return plan_from_spec(spec, seed=int(env.get("ORION_FAULT_SEED", "0")))

@@ -1,0 +1,228 @@
+"""Host-side resilience primitives (SURVEY.md §5 "Failure detection /
+elastic recovery"): retry with deterministic backoff, heartbeat stall
+detection, and circuit breaking.
+
+Pure host code by design — NO jax imports.  Everything here runs on
+driver threads (rollout worker supervision, checkpoint writes, socket
+connects, reward calls) where a hung or flaky dependency must never
+take the training loop down with it.  Determinism is first-class: the
+backoff jitter is seeded (same seed → identical delay sequence), so a
+chaos run under a :class:`~orion_tpu.resilience.inject.FaultPlan`
+replays the exact same recovery schedule twice.
+
+Clocks and sleeps are injectable throughout so the unit tests advance
+virtual time instead of sleeping.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple, Type
+
+
+class RetryPolicy:
+    """Exponential backoff with seeded jitter and attempt/deadline
+    budgets.
+
+    Args:
+      max_attempts: total call attempts (1 = no retry).
+      base_delay: delay before the first retry, seconds.
+      multiplier: backoff growth factor per retry.
+      max_delay: cap on any single delay.
+      jitter: fractional jitter — each delay is scaled by a value in
+        ``[1, 1 + jitter)`` drawn from a ``random.Random(seed)`` stream,
+        so two policies with the same seed produce the same delays
+        (reproducible chaos runs) while distinct seeds desynchronize
+        retry storms.
+      deadline: total retry budget in seconds (None = attempts only).
+        Checked *before* sleeping: a retry whose backoff would overrun
+        the budget re-raises instead of sleeping past it.
+      retry_on: exception classes worth retrying; anything else
+        propagates immediately (a programming error is not transient).
+      seed: jitter stream seed.
+    """
+
+    def __init__(self, max_attempts: int = 3, base_delay: float = 0.05,
+                 multiplier: float = 2.0, max_delay: float = 2.0,
+                 jitter: float = 0.1, deadline: Optional[float] = None,
+                 retry_on: Tuple[Type[BaseException], ...] = (Exception,),
+                 seed: int = 0):
+        if max_attempts < 1:
+            raise ValueError(f"max_attempts must be >= 1, got {max_attempts}")
+        if base_delay < 0 or max_delay < 0 or jitter < 0:
+            raise ValueError("delays and jitter must be non-negative")
+        if multiplier < 1.0:
+            raise ValueError(f"multiplier must be >= 1, got {multiplier}")
+        self.max_attempts = max_attempts
+        self.base_delay = base_delay
+        self.multiplier = multiplier
+        self.max_delay = max_delay
+        self.jitter = jitter
+        self.deadline = deadline
+        self.retry_on = retry_on
+        self.seed = seed
+
+    def delays(self) -> List[float]:
+        """The deterministic backoff schedule: one delay per retry
+        (``max_attempts - 1`` entries), jitter applied."""
+        rng = random.Random(self.seed)
+        out = []
+        d = self.base_delay
+        for _ in range(self.max_attempts - 1):
+            out.append(min(d, self.max_delay) * (1.0 + self.jitter
+                                                 * rng.random()))
+            d *= self.multiplier
+        return out
+
+    def call(self, fn: Callable, *args,
+             on_retry: Optional[Callable] = None,
+             sleep: Callable[[float], None] = time.sleep,
+             clock: Callable[[], float] = time.monotonic, **kwargs):
+        """Run ``fn(*args, **kwargs)`` under this policy.  ``on_retry``
+        (if given) is called as ``on_retry(attempt, exc, delay)`` before
+        each backoff sleep — the hook for logging/metrics."""
+        start = clock()
+        delays = self.delays()
+        for attempt in range(1, self.max_attempts + 1):
+            try:
+                return fn(*args, **kwargs)
+            except self.retry_on as e:
+                if attempt >= self.max_attempts:
+                    raise
+                delay = delays[attempt - 1]
+                if self.deadline is not None and \
+                        clock() - start + delay > self.deadline:
+                    raise
+                if on_retry is not None:
+                    on_retry(attempt, e, delay)
+                sleep(delay)
+        raise AssertionError("unreachable")  # pragma: no cover
+
+
+class Heartbeat:
+    """One registered thread's liveness record.  ``beat()`` is the only
+    method worker code should call — it is a single float store, safe
+    from any thread without taking the registry lock."""
+
+    def __init__(self, name: str, timeout: float,
+                 clock: Callable[[], float]):
+        self.name = name
+        self.timeout = timeout
+        self._clock = clock
+        self.last = clock()
+
+    def beat(self) -> None:
+        self.last = self._clock()
+
+    def stalled(self, now: Optional[float] = None) -> bool:
+        if self.timeout <= 0:
+            return False  # stall detection disabled for this entry
+        now = self._clock() if now is None else now
+        return now - self.last > self.timeout
+
+
+class Watchdog:
+    """Heartbeat registry with stall detection.
+
+    Supervisors ``register`` each worker thread (getting a
+    :class:`Heartbeat` handle the worker beats), then poll ``stalled()``
+    from their own loop.  The watchdog never kills anything itself —
+    Python threads cannot be killed — it only *detects*; the supervisor
+    owns the restart/degrade decision.
+    """
+
+    def __init__(self, clock: Callable[[], float] = time.monotonic):
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._beats: Dict[str, Heartbeat] = {}
+
+    def register(self, name: str, timeout: float = 0.0) -> Heartbeat:
+        """Register (or re-register) a thread.  ``timeout`` seconds
+        without a beat ⇒ stalled; 0 disables stall detection but keeps
+        the liveness record."""
+        hb = Heartbeat(name, timeout, self._clock)
+        with self._lock:
+            self._beats[name] = hb
+        return hb
+
+    def unregister(self, name: str) -> None:
+        with self._lock:
+            self._beats.pop(name, None)
+
+    def beat(self, name: str) -> None:
+        with self._lock:
+            hb = self._beats.get(name)
+        if hb is None:
+            raise KeyError(f"watchdog: no registered heartbeat {name!r}")
+        hb.beat()
+
+    def stalled(self, now: Optional[float] = None) -> List[str]:
+        """Names of every registered entry past its stall timeout."""
+        now = self._clock() if now is None else now
+        with self._lock:
+            entries = list(self._beats.values())
+        return [hb.name for hb in entries if hb.stalled(now)]
+
+    def names(self) -> List[str]:
+        with self._lock:
+            return sorted(self._beats)
+
+
+class CircuitBreaker:
+    """Open after N consecutive failures; half-open probe after a
+    cool-down (the classic three-state breaker).
+
+    States: ``closed`` (calls flow), ``open`` (calls refused until
+    ``reset_timeout`` elapses), ``half-open`` (exactly one probe call
+    allowed; success closes, failure re-opens).  Thread-safe.
+    """
+
+    def __init__(self, failure_threshold: int = 5,
+                 reset_timeout: float = 30.0,
+                 clock: Callable[[], float] = time.monotonic):
+        if failure_threshold < 1:
+            raise ValueError(
+                f"failure_threshold must be >= 1, got {failure_threshold}")
+        self.failure_threshold = failure_threshold
+        self.reset_timeout = reset_timeout
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._failures = 0
+        self._state = "closed"
+        self._opened_at = 0.0
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            if self._state == "open" and \
+                    self._clock() - self._opened_at >= self.reset_timeout:
+                return "half-open"
+            return self._state
+
+    def allow(self) -> bool:
+        """May a call proceed right now?  In the half-open window this
+        admits exactly one probe (subsequent calls are refused until
+        the probe reports success/failure)."""
+        with self._lock:
+            if self._state == "closed":
+                return True
+            if self._state == "open" and \
+                    self._clock() - self._opened_at >= self.reset_timeout:
+                self._state = "half-open"
+                return True  # the single probe
+            return False
+
+    def record_success(self) -> None:
+        with self._lock:
+            self._failures = 0
+            self._state = "closed"
+
+    def record_failure(self) -> None:
+        with self._lock:
+            self._failures += 1
+            if self._state == "half-open" or \
+                    self._failures >= self.failure_threshold:
+                self._state = "open"
+                self._opened_at = self._clock()

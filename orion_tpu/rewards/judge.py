@@ -18,12 +18,14 @@ the reward twice with ``swap=True``).
 
 from __future__ import annotations
 
+import warnings
 from typing import Any, Optional
 
 import jax
 import numpy as np
 
 from orion_tpu.config import ModelConfig, RolloutConfig
+from orion_tpu.resilience import CircuitBreaker, RetryPolicy
 from orion_tpu.rollout import GenerationResult
 
 DEFAULT_TEMPLATE = (
@@ -48,21 +50,50 @@ class JudgeReward:
       template: comparison prompt with {prompt}/{a}/{b} slots.
       swap: present the pair as (B, A) instead — run both orders and
         average the two scores to cancel position bias.
+      retry: RetryPolicy for the verdict generation (default: no
+        retries).  A judge is an auxiliary model — transient failures
+        should not kill the training run.
+      neutral_on_failure: when verdict generation still fails past the
+        retry budget, emit neutral 0.5 scores for the batch (warned
+        loudly, counted in ``self.failures``) instead of raising — an
+        unavailable judge degrades the preference signal to "no
+        preference", which biases DPO toward nothing; a crashed run
+        biases it toward never finishing.  False restores fail-fast.
+      breaker: optional CircuitBreaker around verdict generation.  An
+        outage longer than the retry budget opens the circuit and the
+        batch degrades straight to neutral without paying the retry
+        backoff every call; after ``reset_timeout`` one half-open
+        probe batch tests whether the judge recovered.
     """
 
     # Scores on the host copy: the verdict path re-tokenizes decoded
     # text, so device sequences buy nothing here.
     wants_device_result = False
+    # Class-level resilience defaults (RetryPolicy is stateless per
+    # call) so partially-constructed stubs and subclasses inherit the
+    # no-retry fail-soft behavior; __init__ overrides per instance.
+    retry = RetryPolicy(max_attempts=1)
+    neutral_on_failure = True
+    failures = 0
+    breaker: Optional[CircuitBreaker] = None
 
     def __init__(self, model: Any, model_cfg: ModelConfig, params: Any,
                  tokenizer: Any,
                  rollout_cfg: Optional[RolloutConfig] = None,
-                 template: str = DEFAULT_TEMPLATE, swap: bool = False):
+                 template: str = DEFAULT_TEMPLATE, swap: bool = False,
+                 retry: Optional[RetryPolicy] = None,
+                 neutral_on_failure: bool = True,
+                 breaker: Optional[CircuitBreaker] = None):
         from orion_tpu.rollout import RolloutEngine
 
         self.tok = tokenizer
         self.template = template
         self.swap = swap
+        self.retry = retry if retry is not None else RetryPolicy(
+            max_attempts=1)
+        self.neutral_on_failure = neutral_on_failure
+        self.breaker = breaker
+        self.failures = 0  # batches degraded to neutral scores
         if rollout_cfg is None:
             rollout_cfg = RolloutConfig(
                 max_prompt_len=768, max_new_tokens=4, temperature=0.0)
@@ -111,8 +142,6 @@ class JudgeReward:
                for t in judge_prompts]
         over = sum(len(e) > P for e in enc)
         if over:
-            import warnings
-
             # keep the TAIL on overflow (the verdict slot is at the
             # end) — but a truncated comparison loses the instruction
             # header and part of response A, so degrade LOUDLY: size
@@ -136,7 +165,41 @@ class JudgeReward:
 
         ids_d, lens_d = replicated_put(
             (ids, lens), getattr(self.engine, "_params", None))
-        out = self.engine.generate(ids_d, lens_d, jax.random.key(0))
+        if self.breaker is not None and not self.breaker.allow():
+            # Circuit open: a known-down judge is not re-probed (and
+            # its retry backoff not paid) every batch.  Fail-fast
+            # configs still raise — the breaker changes WHEN failure
+            # is declared, never the configured failure semantics.
+            if not self.neutral_on_failure:
+                raise RuntimeError(
+                    "JudgeReward: circuit open (judge outage) and "
+                    "neutral_on_failure=False")
+            self.failures += 1
+            warnings.warn(
+                "JudgeReward: circuit open (judge outage); emitting "
+                "neutral 0.5 scores without probing", stacklevel=3)
+            return np.full((n,), 0.5, np.float32)
+        try:
+            out = self.retry.call(
+                self.engine.generate, ids_d, lens_d, jax.random.key(0))
+        except Exception as e:
+            if self.breaker is not None:
+                self.breaker.record_failure()
+            if not self.neutral_on_failure:
+                raise
+            # Graceful degradation — loud, counted, unbiased: every
+            # pair scores (0.5, 0.5), the same value an unparsable
+            # verdict gets, so a judge outage never tilts DPO.
+            self.failures += 1
+            warnings.warn(
+                f"JudgeReward: verdict generation failed after "
+                f"{self.retry.max_attempts} attempt(s) "
+                f"({type(e).__name__}: {e}); emitting neutral 0.5 "
+                "scores for this batch — preference signal degraded",
+                stacklevel=3)
+            return np.full((n,), 0.5, np.float32)
+        if self.breaker is not None:
+            self.breaker.record_success()
         comp = np.asarray(out.completions)
         comp_lens = np.asarray(out.completion_lens)
         scores = np.full((n,), 0.5, np.float32)

@@ -647,8 +647,12 @@ class ContinuousBatchingEngine:
         max_new_tokens, if given, must equal cfg.max_new_tokens (the
         page reservations are sized for it)."""
         from orion_tpu.ops.logprobs import pack_sequences
+        from orion_tpu.resilience import fault_point
         from orion_tpu.rollout.engine import GenerationResult
 
+        # Same named fault point as RolloutEngine.generate — chaos
+        # plans target the trainer-facing dispatch of either engine.
+        fault_point("rollout.generate")
         if max_new_tokens is not None and \
                 max_new_tokens != self.cfg.max_new_tokens:
             raise ValueError(

@@ -32,6 +32,7 @@ from orion_tpu.config import ModelConfig, RolloutConfig
 from orion_tpu.models.transformer import init_cache
 from orion_tpu.ops.logprobs import pack_sequences
 from orion_tpu.ops.sampling import sample_tokens
+from orion_tpu.resilience import fault_point
 
 
 @dataclasses.dataclass
@@ -122,6 +123,10 @@ class RolloutEngine:
     def generate(self, prompt_ids: jnp.ndarray, prompt_lens: jnp.ndarray,
                  rng: jax.Array, params: Any = None,
                  max_new_tokens: Optional[int] = None) -> GenerationResult:
+        # Named fault point (orion_tpu.resilience): a chaos plan can
+        # kill generation here deterministically — the supervised
+        # recovery path in the async orchestrator trains against this.
+        fault_point("rollout.generate")
         params = params if params is not None else self._params
         if params is None:
             raise ValueError("no weights loaded: call load_weights() first")

@@ -230,8 +230,19 @@ class BaseTrainer:
         if cfg.checkpoint_dir and cfg.checkpoint_every:
             from orion_tpu.utils.checkpoint import CheckpointManager
 
-            self.ckpt = CheckpointManager(cfg.checkpoint_dir,
-                                          max_to_keep=cfg.checkpoint_keep)
+            self.ckpt = CheckpointManager(
+                cfg.checkpoint_dir, max_to_keep=cfg.checkpoint_keep,
+                save_attempts=cfg.resilience.checkpoint_save_attempts,
+                wait_deadline=cfg.resilience.checkpoint_wait_deadline,
+                retry_seed=cfg.seed)
+        # Deterministic chaos arming (orion_tpu.resilience.inject): a
+        # config-carried fault plan installs process-wide here; the
+        # ORION_FAULT_PLAN env var is the zero-code alternative.
+        if cfg.resilience.fault_plan:
+            from orion_tpu.resilience import install_plan, plan_from_spec
+
+            install_plan(plan_from_spec(cfg.resilience.fault_plan,
+                                        seed=cfg.resilience.fault_seed))
         self.writer = None
         if cfg.log_dir:
             from orion_tpu.utils.metrics import MetricsWriter
@@ -391,11 +402,38 @@ class BaseTrainer:
         """Sequence-level scores [B] as host f32.  ``result`` should be
         the host copy (``GenerationResult.to_host()``) unless the reward
         fn sets ``wants_device_result`` (model-based rewards score on
-        device and pay one fetch for the scalar scores instead)."""
+        device and pay one fetch for the scalar scores instead).
+
+        Resilience: the call runs through the ``reward.call`` fault
+        point and (``resilience.reward_attempts`` > 1) a seeded retry;
+        non-finite scores are surfaced loudly here — the async
+        orchestrator quarantines the batch before the optimizer ever
+        sees it (``resilience.quarantine_nonfinite``)."""
         if self.reward_fn is None:
             raise ValueError("no reward_fn configured")
-        scores = self.reward_fn(result, batch)
-        return np.asarray(scores, np.float32).reshape(-1)
+        from orion_tpu.resilience import fault_point
+
+        def _call():
+            fault_point("reward.call")
+            return self.reward_fn(result, batch)
+
+        rcfg = self.cfg.resilience
+        if rcfg.reward_attempts > 1:
+            scores = rcfg.retry_policy(rcfg.reward_attempts,
+                                       seed=self.cfg.seed).call(_call)
+        else:
+            scores = _call()
+        scores = np.asarray(scores, np.float32).reshape(-1)
+        n_bad = int((~np.isfinite(scores)).sum())
+        if n_bad:
+            import warnings
+
+            warnings.warn(
+                f"reward_fn emitted {n_bad}/{scores.size} non-finite "
+                "scores — the async path quarantines this batch; the "
+                "sync path would feed them to the update step",
+                stacklevel=2)
+        return scores
 
     def prepare_prompts(self, batch: dict):
         """(prompt_ids, prompt_lens, meta) — group trainers (GRPO/RLOO/

@@ -7,16 +7,29 @@ checkpoint per step, with retention and async write handled by Orbax's
 CheckpointManager.  Sharded arrays restore to their saved shardings by
 default (restore on the same mesh), or to target abstract shardings the
 caller passes for elastic reshape.
+
+Hardening (orion_tpu.resilience): saves retry under a seeded backoff
+policy, ``wait`` takes an optional deadline, and a latest-step restore
+falls back step-by-step to the newest checkpoint that actually loads
+when the latest is corrupt — a truncated write from a preempted host
+must cost one checkpoint interval, never the run.
 """
 
 from __future__ import annotations
 
+import logging
 import os
-from typing import Any, Optional
+import threading
+import warnings
+from typing import Any, List, Optional
 
 import jax
 import numpy as np
 import orbax.checkpoint as ocp
+
+from orion_tpu.resilience import RetryPolicy, fault_point
+
+_LOG = logging.getLogger(__name__)
 
 
 class CheckpointManager:
@@ -30,8 +43,13 @@ class CheckpointManager:
     """
 
     def __init__(self, directory: str, max_to_keep: int = 3,
-                 async_save: bool = True):
+                 async_save: bool = True, save_attempts: int = 3,
+                 wait_deadline: float = 0.0, retry_seed: int = 0):
         self.directory = os.path.abspath(directory)
+        self.wait_deadline = wait_deadline
+        self._save_retry = RetryPolicy(
+            max_attempts=max(1, save_attempts), base_delay=0.05,
+            max_delay=1.0, seed=retry_seed)
         options = ocp.CheckpointManagerOptions(
             max_to_keep=max_to_keep,
             enable_async_checkpointing=async_save,
@@ -53,16 +71,55 @@ class CheckpointManager:
             items["critic_state"] = ocp.args.StandardSave(critic_state)
         if extra is not None:
             items["extra"] = ocp.args.JsonSave(_jsonable(extra))
-        self._mgr.save(step, args=ocp.args.Composite(**items))
+
+        def _write() -> None:
+            fault_point("checkpoint.save")
+            self._mgr.save(step, args=ocp.args.Composite(**items))
+
+        # Retried: orbax stages into a tmp dir and commits by rename,
+        # so a failed attempt leaves no half-step behind to collide
+        # with the retry.  Scope: with async_save the retry covers the
+        # synchronous staging/enqueue half of save(); a failure on the
+        # background writer thread surfaces later (at wait()/the next
+        # save) after the args are gone, so that step is lost — the
+        # restore-side fallback walk is the backstop that keeps a lost
+        # step from costing more than one checkpoint interval.
+        self._save_retry.call(_write, on_retry=lambda a, e, d: _LOG.warning(
+            "checkpoint save step %d failed (attempt %d: %r); "
+            "retrying in %.2fs", step, a, e, d))
 
     def restore(self, step: Optional[int] = None, state_template: Any = None,
                 critic_template: Any = None) -> dict:
         """Restore the latest (or given) step.  Templates are pytrees of
         arrays (or ShapeDtypeStruct with shardings) matching what was
-        saved; pass the freshly-initialized TrainState."""
-        step = step if step is not None else self.latest_step()
-        if step is None:
+        saved; pass the freshly-initialized TrainState.
+
+        Latest-step restores degrade gracefully: a step that fails to
+        load (torn write, corrupt file) is skipped with a warning and
+        the next-newest step is tried — an explicitly requested
+        ``step`` stays strict and raises."""
+        if step is not None:
+            return self._restore_step(step, state_template, critic_template)
+        steps = sorted(self.all_steps(), reverse=True)
+        if not steps:
             raise FileNotFoundError(f"no checkpoint in {self.directory}")
+        last_err: Optional[BaseException] = None
+        for s in steps:
+            try:
+                return self._restore_step(s, state_template, critic_template)
+            except Exception as e:
+                last_err = e
+                warnings.warn(
+                    f"checkpoint step {s} in {self.directory} failed to "
+                    f"restore ({type(e).__name__}: {e}); falling back to "
+                    "the previous step", stacklevel=2)
+        raise RuntimeError(
+            f"no checkpoint step in {self.directory} could be restored "
+            f"(tried {steps})") from last_err
+
+    def _restore_step(self, step: int, state_template: Any,
+                      critic_template: Any) -> dict:
+        fault_point("checkpoint.restore")
         items = {}
         if state_template is not None:
             items["state"] = ocp.args.StandardRestore(state_template)
@@ -72,7 +129,8 @@ class CheckpointManager:
         try:
             out = self._mgr.restore(step, args=ocp.args.Composite(**items))
         except Exception:
-            # checkpoint saved without `extra`
+            # checkpoint saved without `extra` (a genuinely corrupt step
+            # fails this retry too and surfaces to the fallback walk)
             items.pop("extra")
             out = self._mgr.restore(step, args=ocp.args.Composite(**items))
         return dict(out)
@@ -80,9 +138,26 @@ class CheckpointManager:
     def latest_step(self) -> Optional[int]:
         return self._mgr.latest_step()
 
-    def wait(self) -> None:
-        """Block until in-flight async saves land (call before exit)."""
-        self._mgr.wait_until_finished()
+    def all_steps(self) -> List[int]:
+        return sorted(self._mgr.all_steps())
+
+    def wait(self, deadline: Optional[float] = None) -> None:
+        """Block until in-flight async saves land (call before exit).
+        ``deadline`` seconds (default: constructor's ``wait_deadline``;
+        0 = forever) — a wedged async writer must not hang shutdown, so
+        past the deadline this raises TimeoutError instead."""
+        d = self.wait_deadline if deadline is None else deadline
+        if not d:
+            self._mgr.wait_until_finished()
+            return
+        t = threading.Thread(  # orion: ignore[unsupervised-thread] bounded by the join deadline below; abandoned on timeout by design
+            target=self._mgr.wait_until_finished, daemon=True)
+        t.start()
+        t.join(timeout=d)
+        if t.is_alive():
+            raise TimeoutError(
+                f"checkpoint wait_until_finished did not land within "
+                f"{d:.1f}s (async writer wedged?)")
 
     def close(self) -> None:
         self._mgr.wait_until_finished()

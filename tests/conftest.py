@@ -55,6 +55,10 @@ def pytest_configure(config):
         "markers", "smoke: fast pre-commit gate (`pytest -m smoke`, "
         "<5 min) — the dryrun artifact + one bf16 test per parallelism "
         "strategy + a tiny trainer loop; the full suite is the nightly")
+    config.addinivalue_line(
+        "markers", "slow: excluded from the tier-1 gate "
+        "(`-m 'not slow'`) — wall-clock-heavy scenarios (e.g. watchdog "
+        "stall detection) that the nightly full suite still runs")
 
 
 def pytest_collection_modifyitems(config, items):

@@ -390,6 +390,46 @@ FIXTURES = [
         """,
         "bench_fake.py",
     ),
+    (
+        "unsupervised-thread",
+        """
+        import threading
+
+        def spawn(fn):
+            t = threading.Thread(target=fn, daemon=True)
+            t.start()
+            return t
+        """,
+        """
+        import threading
+
+        def spawn(fn, watchdog):
+            hb = watchdog.register("worker", timeout=30.0)
+            t = threading.Thread(target=fn, args=(hb,), daemon=True)
+            t.start()
+            return t
+        """,
+        "orion_tpu/fake_worker.py",
+    ),
+    (
+        "unsupervised-thread",
+        """
+        from threading import Thread
+
+        def spawn(fn):
+            return Thread(target=fn)
+        """,
+        """
+        from threading import Thread
+
+        from orion_tpu.resilience import Watchdog
+
+        def spawn(fn):
+            Watchdog().register("worker", timeout=5.0)
+            return Thread(target=fn)
+        """,
+        "orion_tpu/fake_worker2.py",
+    ),
 ]
 
 

@@ -1,0 +1,459 @@
+"""orion_tpu.resilience: unit tests for the host-side primitives
+(RetryPolicy / Watchdog / CircuitBreaker — all deterministic, virtual
+clocks, no sleeping), the seeded fault-point registry, checkpoint
+corruption fallback, the remote channel's jittered connect backoff,
+and a parametrized chaos sweep: a seeded FaultPlan fires at ≥3
+different production fault points and every run still completes."""
+
+import os
+import socket
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from orion_tpu.config import GRPOConfig, MeshConfig, ResilienceConfig
+from orion_tpu.resilience import (CircuitBreaker, FaultPlan, InjectedFault,
+                                  RetryPolicy, Watchdog, active_plan,
+                                  current_plan, fault_point, plan_from_env,
+                                  plan_from_spec)
+
+from test_trainers import lucky_token_reward, prompt_stream, _mk
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def sleep(self, dt):
+        self.t += dt
+
+
+# ---------------------------------------------------------------------------
+# RetryPolicy
+# ---------------------------------------------------------------------------
+
+
+def test_retry_delays_are_deterministic_and_seeded():
+    a = RetryPolicy(max_attempts=5, base_delay=0.1, seed=7).delays()
+    b = RetryPolicy(max_attempts=5, base_delay=0.1, seed=7).delays()
+    c = RetryPolicy(max_attempts=5, base_delay=0.1, seed=8).delays()
+    assert a == b
+    assert a != c
+    assert len(a) == 4
+    # exponential growth under the cap, jitter bounded
+    assert a[0] < a[1] < a[2]
+    for i, d in enumerate(a[:-1]):
+        base = min(0.1 * 2 ** i, 2.0)
+        assert base <= d <= base * 1.1
+
+
+def test_retry_succeeds_after_transient_failures():
+    clock = FakeClock()
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise OSError("transient")
+        return "ok"
+
+    policy = RetryPolicy(max_attempts=3, base_delay=0.1, seed=0)
+    slept = []
+    out = policy.call(flaky, sleep=slept.append, clock=clock)
+    assert out == "ok" and calls["n"] == 3
+    assert slept == policy.delays()[:2]
+
+
+def test_retry_exhausts_attempts_and_reraises():
+    calls = {"n": 0}
+
+    def always_fails():
+        calls["n"] += 1
+        raise ValueError("nope")
+
+    with pytest.raises(ValueError, match="nope"):
+        RetryPolicy(max_attempts=3, seed=0).call(
+            always_fails, sleep=lambda _: None)
+    assert calls["n"] == 3
+
+
+def test_retry_allowlist_propagates_foreign_exceptions():
+    calls = {"n": 0}
+
+    def raises_type_error():
+        calls["n"] += 1
+        raise TypeError("programming error")
+
+    with pytest.raises(TypeError):
+        RetryPolicy(max_attempts=5, retry_on=(OSError,), seed=0).call(
+            raises_type_error, sleep=lambda _: None)
+    assert calls["n"] == 1  # no retry on a non-allowlisted exception
+
+
+def test_retry_deadline_budget():
+    clock = FakeClock()
+
+    def always_fails():
+        raise OSError("down")
+
+    # base 1.0s backoff, 0.5s total budget: the first retry would
+    # overrun the deadline, so the call re-raises after ONE attempt.
+    policy = RetryPolicy(max_attempts=10, base_delay=1.0, jitter=0.0,
+                         deadline=0.5, seed=0)
+    with pytest.raises(OSError):
+        policy.call(always_fails, sleep=clock.sleep, clock=clock)
+    assert clock.t == 0.0  # never slept past the budget
+
+
+# ---------------------------------------------------------------------------
+# Watchdog
+# ---------------------------------------------------------------------------
+
+
+def test_watchdog_detects_stall_and_beat_clears_it():
+    clock = FakeClock()
+    wd = Watchdog(clock=clock)
+    hb = wd.register("worker", timeout=1.0)
+    assert wd.stalled() == []
+    clock.t = 2.0
+    assert wd.stalled() == ["worker"]
+    hb.beat()
+    assert wd.stalled() == []
+    clock.t = 5.0
+    assert hb.stalled()
+    wd.unregister("worker")
+    assert wd.stalled() == [] and wd.names() == []
+
+
+def test_watchdog_zero_timeout_disables_stall_detection():
+    clock = FakeClock()
+    wd = Watchdog(clock=clock)
+    wd.register("tracked-only", timeout=0.0)
+    clock.t = 1e9
+    assert wd.stalled() == []
+
+
+def test_watchdog_beat_by_name_and_unknown_raises():
+    clock = FakeClock()
+    wd = Watchdog(clock=clock)
+    wd.register("w", timeout=1.0)
+    clock.t = 10.0
+    wd.beat("w")
+    assert wd.stalled() == []
+    with pytest.raises(KeyError):
+        wd.beat("nope")
+
+
+# ---------------------------------------------------------------------------
+# CircuitBreaker
+# ---------------------------------------------------------------------------
+
+
+def test_circuit_breaker_opens_then_half_open_probe():
+    clock = FakeClock()
+    br = CircuitBreaker(failure_threshold=2, reset_timeout=10.0,
+                        clock=clock)
+    assert br.state == "closed" and br.allow()
+    br.record_failure()
+    assert br.state == "closed" and br.allow()
+    br.record_failure()  # threshold hit
+    assert br.state == "open" and not br.allow()
+    clock.t = 5.0
+    assert not br.allow()  # still cooling down
+    clock.t = 11.0
+    assert br.state == "half-open"
+    assert br.allow()       # the single probe
+    assert not br.allow()   # nothing else until the probe reports
+    br.record_success()
+    assert br.state == "closed" and br.allow()
+
+
+def test_circuit_breaker_half_open_failure_reopens():
+    clock = FakeClock()
+    br = CircuitBreaker(failure_threshold=1, reset_timeout=10.0,
+                        clock=clock)
+    br.record_failure()
+    clock.t = 11.0
+    assert br.allow()
+    br.record_failure()  # probe failed
+    assert br.state == "open" and not br.allow()
+    clock.t = 22.0
+    assert br.state == "half-open"
+
+
+# ---------------------------------------------------------------------------
+# FaultPlan / fault points
+# ---------------------------------------------------------------------------
+
+
+def _fire_pattern(plan, point, n):
+    out = []
+    for _ in range(n):
+        try:
+            plan.check(point)
+            out.append(False)
+        except InjectedFault:
+            out.append(True)
+    return out
+
+
+def test_fault_plan_at_fires_on_exact_hits():
+    plan = FaultPlan({"rollout.generate": {"at": (2, 5)}}, seed=0)
+    assert _fire_pattern(plan, "rollout.generate", 6) == \
+        [False, True, False, False, True, False]
+    assert plan.events == [("rollout.generate", 2),
+                           ("rollout.generate", 5)]
+
+
+def test_fault_plan_after_fires_every_later_hit():
+    plan = FaultPlan({"queue.put": {"after": 2}}, seed=0)
+    assert _fire_pattern(plan, "queue.put", 5) == \
+        [False, False, True, True, True]
+
+
+def test_fault_plan_probabilistic_is_seeded_and_capped():
+    p1 = _fire_pattern(FaultPlan({"reward.call": {"p": 0.3}}, seed=3),
+                       "reward.call", 200)
+    p2 = _fire_pattern(FaultPlan({"reward.call": {"p": 0.3}}, seed=3),
+                       "reward.call", 200)
+    p3 = _fire_pattern(FaultPlan({"reward.call": {"p": 0.3}}, seed=4),
+                       "reward.call", 200)
+    assert p1 == p2          # same seed → identical chaos
+    assert p1 != p3          # different seed → different schedule
+    assert 20 < sum(p1) < 100
+    capped = _fire_pattern(
+        FaultPlan({"reward.call": {"p": 1.0, "times": 2}}, seed=0),
+        "reward.call", 10)
+    assert sum(capped) == 2 and capped[:2] == [True, True]
+
+
+def test_fault_plan_rejects_unknown_points_and_bad_specs():
+    with pytest.raises(ValueError, match="unknown fault point"):
+        FaultPlan({"rollout.typo": {"at": 1}})
+    with pytest.raises(ValueError, match="1-indexed"):
+        FaultPlan({"queue.put": {"at": 0}})
+    with pytest.raises(ValueError, match="p must be"):
+        FaultPlan({"queue.put": {"p": 1.5}})
+    plan = FaultPlan({"queue.put": {"at": 1}})
+    with pytest.raises(ValueError, match="not a registered"):
+        plan.check("not.a.point")
+
+
+def test_plan_from_spec_and_env():
+    plan = plan_from_spec(
+        "rollout.generate:at=4+5;checkpoint.save:p=0.25,times=2", seed=9)
+    assert plan.seed == 9
+    assert _fire_pattern(plan, "rollout.generate", 5)[3:] == [True, True]
+    assert plan_from_env({}) is None
+    env_plan = plan_from_env({"ORION_FAULT_PLAN": "weight_sync:at=1",
+                              "ORION_FAULT_SEED": "5"})
+    assert env_plan is not None and env_plan.seed == 5
+    with pytest.raises(ValueError):
+        plan_from_spec("weight_sync:bogus=1")
+
+
+def test_fault_point_noop_without_plan_and_scoped_arming():
+    assert current_plan() is None
+    fault_point("rollout.generate")  # no plan → no-op
+    with active_plan(FaultPlan({"weight_sync": {"at": 1}})) as plan:
+        assert current_plan() is plan
+        with pytest.raises(InjectedFault):
+            fault_point("weight_sync")
+    assert current_plan() is None
+
+
+# ---------------------------------------------------------------------------
+# checkpoint hardening
+# ---------------------------------------------------------------------------
+
+
+def _corrupt_dir(path):
+    """Truncate every file under a checkpoint step dir — the torn-write
+    / preempted-host disk state."""
+    n = 0
+    for root, _dirs, files in os.walk(path):
+        for name in files:
+            with open(os.path.join(root, name), "wb"):
+                pass
+            n += 1
+    assert n > 0, f"nothing to corrupt under {path}"
+
+
+def test_checkpoint_corrupt_latest_falls_back_to_previous(tmp_path):
+    from orion_tpu.utils.checkpoint import CheckpointManager
+
+    d = str(tmp_path / "ckpt")
+    mgr = CheckpointManager(d, async_save=False)
+    mgr.save(1, {"w": jnp.arange(4, dtype=jnp.float32)},
+             extra={"global_iter": 1})
+    mgr.save(2, {"w": jnp.arange(4, dtype=jnp.float32) + 100.0},
+             extra={"global_iter": 2})
+    mgr.wait()
+    assert mgr.latest_step() == 2
+    _corrupt_dir(os.path.join(d, "2"))
+
+    mgr2 = CheckpointManager(d, async_save=False)
+    template = {"w": jnp.zeros(4, jnp.float32)}
+    with pytest.warns(UserWarning, match="failed to restore"):
+        out = mgr2.restore(state_template=template)
+    np.testing.assert_allclose(np.asarray(out["state"]["w"]),
+                               np.arange(4, dtype=np.float32))
+    assert out["extra"]["global_iter"] == 1
+
+
+def test_checkpoint_explicit_step_stays_strict(tmp_path):
+    from orion_tpu.utils.checkpoint import CheckpointManager
+
+    d = str(tmp_path / "ckpt")
+    mgr = CheckpointManager(d, async_save=False)
+    mgr.save(1, {"w": jnp.ones(2, jnp.float32)})
+    mgr.save(2, {"w": jnp.ones(2, jnp.float32) * 2})
+    mgr.wait()
+    _corrupt_dir(os.path.join(d, "2"))
+    mgr2 = CheckpointManager(d, async_save=False)
+    with pytest.raises(Exception):
+        mgr2.restore(step=2,
+                     state_template={"w": jnp.zeros(2, jnp.float32)})
+
+
+def test_checkpoint_save_retries_through_injected_fault(tmp_path):
+    from orion_tpu.utils.checkpoint import CheckpointManager
+
+    d = str(tmp_path / "ckpt")
+    mgr = CheckpointManager(d, async_save=False, save_attempts=3)
+    with active_plan(FaultPlan({"checkpoint.save": {"at": 1}})) as plan:
+        mgr.save(1, {"w": jnp.ones(2, jnp.float32)})
+    mgr.wait()
+    assert plan.events == [("checkpoint.save", 1)]
+    assert mgr.latest_step() == 1
+
+    strict = CheckpointManager(str(tmp_path / "strict"), async_save=False,
+                               save_attempts=1)
+    with active_plan(FaultPlan({"checkpoint.save": {"at": 1}})):
+        with pytest.raises(InjectedFault):
+            strict.save(1, {"w": jnp.ones(2, jnp.float32)})
+
+
+def test_checkpoint_wait_deadline(tmp_path):
+    from orion_tpu.utils.checkpoint import CheckpointManager
+
+    mgr = CheckpointManager(str(tmp_path / "ckpt"), async_save=False)
+    mgr.wait(deadline=1.0)  # nothing in flight: returns immediately
+    mgr._mgr.wait_until_finished = lambda: time.sleep(30)
+    with pytest.raises(TimeoutError, match="did not land"):
+        mgr.wait(deadline=0.2)
+
+
+# ---------------------------------------------------------------------------
+# remote channel
+# ---------------------------------------------------------------------------
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("localhost", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_connect_timeout_surfaces_last_socket_error():
+    from orion_tpu.orchestration.remote import PyTreeChannel
+
+    t0 = time.monotonic()
+    with pytest.raises(TimeoutError, match="last socket error") as ei:
+        PyTreeChannel.connect(_free_port(), timeout=0.4)
+    assert isinstance(ei.value.__cause__, OSError)
+    # backoff is capped by the remaining budget — no overshoot
+    assert time.monotonic() - t0 < 5.0
+
+
+def test_channel_send_hits_the_fault_point():
+    from orion_tpu.orchestration.remote import PyTreeChannel
+
+    srv = socket.socket()
+    srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    srv.bind(("localhost", 0))
+    srv.listen(1)
+    port = srv.getsockname()[1]
+    client = socket.create_connection(("localhost", port))
+    conn, _ = srv.accept()
+    srv.close()
+    a, b = PyTreeChannel(client), PyTreeChannel(conn)
+    try:
+        with active_plan(FaultPlan({"remote.channel": {"at": 1}})):
+            with pytest.raises(InjectedFault):
+                a.send({"x": np.arange(3)})
+        a.send({"x": np.arange(3)})  # healed channel still works
+        out = b.recv()
+        np.testing.assert_array_equal(out["x"], np.arange(3))
+    finally:
+        a.close()
+        b.close()
+
+
+# ---------------------------------------------------------------------------
+# chaos sweep: ≥3 fault points, every run completes
+# ---------------------------------------------------------------------------
+
+
+def _build_async(tmp_path, reward_fn=lucky_token_reward, **res_kw):
+    from orion_tpu.models import Transformer
+    from orion_tpu.models.sharded import make_sharded_model
+    from orion_tpu.orchestration import AsyncOrchestrator, split_devices
+    from orion_tpu.parallel.mesh import make_mesh
+    from orion_tpu.trainers import GRPOTrainer
+
+    cfg = _mk(GRPOConfig, group_size=4, kl_coef=0.0, num_epochs=1,
+              async_mode=True, async_staleness=1, seed=0,
+              checkpoint_dir=str(tmp_path / "ckpt"), checkpoint_every=2,
+              resilience=ResilienceConfig(**res_kw))
+    rollout_devs, train_devs = split_devices(jax.devices(), 4)
+    train_mesh = make_mesh(MeshConfig(data=1, fsdp=-1, seq=1, tensor=1),
+                           devices=train_devs)
+    model = Transformer(cfg.model)
+    init_args = (jnp.zeros((1, 2), jnp.int32), jnp.zeros((1, 2), jnp.int32))
+    params, _ = make_sharded_model(model, train_mesh, jax.random.key(0),
+                                   init_args)
+    trainer = GRPOTrainer(cfg, model, params,
+                          reward_fn=reward_fn, eos_token_id=None)
+    return cfg, trainer, AsyncOrchestrator(trainer, rollout_devs)
+
+
+CHAOS_CASES = [
+    # (spec, resilience knobs) — each targets a different fault point;
+    # every run must END COMPLETED with the fault having fired.
+    ({"rollout.generate": {"at": (2,)}},
+     dict(max_rollout_restarts=2, degrade_to_sync=True)),
+    ({"queue.put": {"at": (1,)}},
+     dict(max_rollout_restarts=2, degrade_to_sync=True)),
+    ({"weight_sync": {"at": (2,)}},
+     dict(weight_sync_attempts=3)),
+    ({"checkpoint.save": {"at": (1,)}},
+     dict(checkpoint_save_attempts=3)),
+    ({"reward.call": {"at": (2,)}},
+     dict(reward_attempts=2, max_rollout_restarts=1,
+          degrade_to_sync=True)),
+]
+
+
+@pytest.mark.parametrize(
+    "spec,res_kw", CHAOS_CASES,
+    ids=[next(iter(s)) for s, _ in CHAOS_CASES])
+def test_chaos_run_completes(tmp_path, spec, res_kw):
+    plan = FaultPlan(spec, seed=0)
+    cfg, trainer, orch = _build_async(tmp_path, **res_kw)
+    with active_plan(plan):
+        history = orch.train(prompt_stream(2, 4), num_iterations=4)
+    assert plan.events, "the injected fault never fired"
+    assert len(history) == 4
+    assert trainer.global_iter == 4
+    for h in history:
+        if "loss" in h:
+            assert np.isfinite(h["loss"])

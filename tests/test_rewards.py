@@ -214,3 +214,75 @@ def test_judge_reward_real_engine_tiny_model():
     assert s1.shape == (4,)
     for i in range(0, 4, 2):
         assert (s1[i], s1[i + 1]) in ((1.0, 0.0), (0.0, 1.0), (0.5, 0.5))
+
+
+def test_judge_reward_degrades_to_neutral_on_engine_failure():
+    """A judge whose engine fails past the retry budget emits neutral
+    0.5 scores (loudly, counted) instead of killing the run — and a
+    healed engine scores normally again."""
+    import pytest
+
+    judge = _stub_judge(["A"])
+
+    def boom(*a, **kw):
+        raise RuntimeError("judge down")
+
+    real_generate = judge.engine.generate
+    judge.engine.generate = boom
+    res = _pair_result(["good answer", "bad answer"])
+    with pytest.warns(UserWarning, match="neutral"):
+        scores = judge(res, {})
+    np.testing.assert_array_equal(scores, [0.5, 0.5])
+    assert judge.failures == 1
+    judge.engine.generate = real_generate
+    np.testing.assert_array_equal(judge(res, {}), [1.0, 0.0])
+
+
+def test_judge_reward_failfast_when_configured():
+    judge = _stub_judge(["A"])
+    judge.neutral_on_failure = False
+
+    def boom(*a, **kw):
+        raise RuntimeError("judge down")
+
+    judge.engine.generate = boom
+    import pytest
+
+    with pytest.raises(RuntimeError, match="judge down"):
+        judge(_pair_result(["x", "y"]), {})
+
+
+def test_judge_reward_circuit_breaker_skips_probing_during_outage():
+    """With a breaker attached, an outage past failure_threshold opens
+    the circuit: later batches degrade straight to neutral WITHOUT
+    calling the engine, and the half-open probe after the cool-down
+    closes it again once the judge heals."""
+    import pytest
+
+    from orion_tpu.resilience import CircuitBreaker
+
+    t = [0.0]
+    judge = _stub_judge(["A"])
+    judge.breaker = CircuitBreaker(failure_threshold=2, reset_timeout=10.0,
+                                   clock=lambda: t[0])
+    calls = {"n": 0}
+    real_generate = judge.engine.generate
+
+    def boom(*a, **kw):
+        calls["n"] += 1
+        raise RuntimeError("judge down")
+
+    judge.engine.generate = boom
+    res = _pair_result(["good answer", "bad answer"])
+    with pytest.warns(UserWarning):
+        judge(res, {})  # failure 1: breaker still closed
+        judge(res, {})  # failure 2: breaker opens
+        judge(res, {})  # circuit open: engine NOT probed
+    assert calls["n"] == 2
+    assert judge.failures == 3
+    assert judge.breaker.state == "open"
+    # cool-down elapses; the healed engine answers the half-open probe
+    judge.engine.generate = real_generate
+    t[0] = 11.0
+    np.testing.assert_array_equal(judge(res, {}), [1.0, 0.0])
+    assert judge.breaker.state == "closed"
