@@ -835,3 +835,33 @@ def _check_unsupervised_thread(ctx: ModuleContext):
                  "Watchdog in the spawning function (see "
                  "AsyncOrchestrator._spawn_worker), or justify with "
                  "# orion: ignore[unsupervised-thread]")
+
+
+# ---------------------------------------------------------------------------
+# rule: raw-socket
+# ---------------------------------------------------------------------------
+
+_SOCKET_CALLS = {"socket.socket", "socket.create_connection"}
+
+
+@rule("raw-socket",
+      "raw socket construction outside orchestration/remote.py — "
+      "cross-process IO must ride the hardened PyTreeChannel "
+      "(keepalive, framed protocol, fault points)")
+def _check_raw_socket(ctx: ModuleContext):
+    # remote.py IS the hardened channel: the one module allowed to
+    # touch sockets directly.
+    if ctx.path.replace(os.sep, "/").endswith("orchestration/remote.py"):
+        return
+    for node in ctx.walk():
+        if not isinstance(node, ast.Call):
+            continue
+        d = ctx.dotted(node.func)
+        if d in _SOCKET_CALLS:
+            yield Finding(
+                "raw-socket", ctx.path, node.lineno,
+                f"{d}() outside orchestration/remote.py — unframed, "
+                "no keepalive, invisible to the channel fault points",
+                hint="use orion_tpu.orchestration.remote.PyTreeChannel"
+                     " / WorkerPool; a non-IO use (free-port probe) "
+                     "can justify # orion: ignore[raw-socket]")

@@ -374,6 +374,27 @@ class ResilienceConfig:
     # Skip (+ count) dequeued batches whose scores/logprobs contain
     # non-finite values instead of feeding them to the update step.
     quarantine_nonfinite: bool = True
+    # -- cross-process worker pool (orchestration.remote.WorkerPool) ---
+    # Rollout worker processes the learner waits for before training
+    # starts (elastic: more may join, members may leave/rejoin mid-run).
+    pool_size: int = 1
+    # Worker-side heartbeat send cadence (seconds).  The learner-side
+    # stall cutoff is `heartbeat_timeout` above (shared with the
+    # in-process supervisor); keep timeout >> interval.
+    heartbeat_interval: float = 0.5
+    # Admissions allowed AFTER the first death/leave (churn bound): a
+    # worker flapping in a crash loop must not grind the learner
+    # through endless re-admission weight syncs.
+    rejoin_budget: int = 4
+    # Seconds an EMPTY pool waits for a (re)join before the supervisor
+    # invokes the ladder (degrade_to_sync → sync rollout on the train
+    # mesh, else fail fast).
+    rejoin_grace: float = 2.0
+    # Idle-receive deadline (s) for the hardened PyTreeChannel: a recv
+    # seeing no bytes this long raises instead of hanging the learner
+    # on a silently dead peer.  0 = block forever (SO_KEEPALIVE still
+    # bounds silent host death at the kernel level).
+    channel_recv_deadline: float = 0.0
     # -- retries -------------------------------------------------------
     reward_attempts: int = 1        # reward_fn call attempts
     weight_sync_attempts: int = 1   # learner→rollout broadcast attempts

@@ -1,4 +1,12 @@
 from orion_tpu.orchestration.async_orchestrator import (  # noqa: F401
     AsyncOrchestrator,
+    PoolOrchestrator,
     split_devices,
+)
+from orion_tpu.orchestration.remote import (  # noqa: F401
+    PoolWorkerClient,
+    ProtocolError,
+    PyTreeChannel,
+    WorkerPool,
+    host_tree,
 )

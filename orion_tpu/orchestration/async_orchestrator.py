@@ -37,6 +37,15 @@ behavior logprobs are quarantined (skipped + counted), never donated
 into the optimizer.  Every recovery decision lands in ``self.events``
 (a deterministic sequence under a seeded FaultPlan) and in the metrics
 stream.
+
+Cross-process (:class:`PoolOrchestrator`): the same supervisor role
+over N rollout *processes* through a
+:class:`~orion_tpu.orchestration.remote.WorkerPool` — per-worker
+heartbeats and queues, weight fan-out with version tags, dead workers'
+in-flight batches discarded, survivors absorbing the load, and the
+ladder firing only on an EMPTY pool.  Both loops poll
+``resilience.preemption`` at iteration boundaries: SIGTERM finishes
+the in-flight step, checkpoints, GOODBYEs the workers, and returns.
 """
 
 from __future__ import annotations
@@ -56,7 +65,8 @@ import numpy as np
 from orion_tpu.models.sharded import mesh_shardings_for
 from orion_tpu.parallel.mesh import make_mesh
 from orion_tpu.config import MeshConfig, ResilienceConfig
-from orion_tpu.resilience import Heartbeat, Watchdog, fault_point
+from orion_tpu.resilience import (Heartbeat, Watchdog, fault_point,
+                                  preemption_requested)
 from orion_tpu.trainers.base import BaseTrainer
 
 _LOG = logging.getLogger(__name__)
@@ -78,6 +88,78 @@ class _Item:
     scores: np.ndarray       # [B]
     version: int             # weight version used for generation
     data_state: Optional[dict] = None  # prompt-iterator cursor snapshot
+
+
+def _sync_rollout_item(orch, prompt_iter: Iterator[dict]) -> _Item:
+    """Graceful-degradation rollout shared by both supervisors:
+    generate ON THE TRAIN MESH with the trainer's own engine (a dead
+    worker's engine — thread or process — must not be raced).  Slower
+    — the learner stalls for each generation — but the run completes,
+    staleness drops to 0, and every degraded iteration is
+    metrics-tagged.  ``orch`` is either orchestrator (both carry
+    ``trainer`` / ``recovery`` / ``_rng`` / ``_version``)."""
+    trainer = orch.trainer
+    orch.recovery["degraded_iterations"] += 1
+    batch = next(prompt_iter)
+    data_state = prompt_iter.state() \
+        if hasattr(prompt_iter, "state") else None
+    ids, lens, meta = trainer.prepare_prompts(batch)
+    # The update step donates the old param buffers, so the
+    # trainer-side engine must re-sync every iteration here (in
+    # async mode nothing else calls sync_weights).
+    trainer.sync_weights()
+    orch._rng, sub = jax.random.split(orch._rng)
+    result = trainer.generate(
+        np.asarray(ids), np.asarray(lens), rng=sub,
+        group_size=int(getattr(trainer.cfg, "group_size", 1)))
+    host = result.to_host()
+    scores = trainer._score_result(result, host, meta)
+    return _Item(host._fields(), scores, orch._version, data_state)
+
+
+def _compute_dtype_params(orch):
+    """Policy params cast to the engines' compute dtype ON THE TRAIN
+    MESH, shared by both weight-sync paths (VERDICT r4 weak #4): the
+    engines cast before every decode anyway, so shipping f32 across
+    the group/DCN boundary doubles the sync bytes for nothing — 32
+    GB/update at the 8B flagship config, 16 GB after this cast.
+    Numerics are unchanged: int8 engine quantization already started
+    from the compute-dtype copy.  ``orch`` is either orchestrator; the
+    jitted cast is cached per instance."""
+    trainer = orch.trainer
+    params = trainer.state.params
+    cdt = jnp.dtype(trainer.cfg.model.dtype)
+    if cdt != jnp.dtype(trainer.cfg.model.param_dtype):
+        if not hasattr(orch, "_jit_bcast_cast"):
+            orch._jit_bcast_cast = jax.jit(lambda p: jax.tree.map(
+                lambda x: x.astype(cdt)
+                if jnp.issubdtype(x.dtype, jnp.floating) else x, p))
+        params = orch._jit_bcast_cast(params)
+    return params
+
+
+def _quarantine_reason(item: _Item) -> Optional[str]:
+    """Non-finite screen over the fields the optimizer consumes:
+    scores (reward path) and behavior logprobs (importance ratio).
+    A NaN here, donated into the update, corrupts the params for
+    every later step — skipping one batch is strictly cheaper.  For a
+    POOL item the same screen doubles as the cross-process integrity
+    gate: a half-written trajectory from a dying worker surfaces as
+    garbage values here, never in the optimizer."""
+    if not np.isfinite(np.asarray(item.scores)).all():
+        return "scores"
+    lp = item.result_host.get("logprobs")
+    if lp is not None:
+        lp = np.asarray(lp)
+        mask = item.result_host.get("completion_mask")
+        # Screen only REAL completion positions: padded tail slots
+        # may legitimately hold -inf from sampling masks.
+        bad = ~np.isfinite(lp)
+        if mask is not None:
+            bad &= np.asarray(mask, bool)
+        if bad.any():
+            return "logprobs"
+    return None
 
 
 class AsyncOrchestrator:
@@ -207,25 +289,13 @@ class AsyncOrchestrator:
         tensor sharding on the same mesh.
 
         The f32 master tree is cast to the engines' compute dtype ON
-        THE TRAIN MESH first (VERDICT r4 weak #4): the engines cast
-        before every decode anyway (the cast runs first in
-        ``prep_decode_params``), so shipping f32 across the group boundary
-        doubled the sync bytes for nothing — 32 GB/update at the 8B
-        flagship config, 16 GB after this cast.  Numerics are
-        unchanged: int8 engine quantization already started from the
-        compute-dtype copy."""
+        THE TRAIN MESH first (``_compute_dtype_params``, shared with
+        the pool's DCN fan-out)."""
 
         def _sync() -> None:
             fault_point("weight_sync")
-            params = self.trainer.state.params
-            cdt = jnp.dtype(self.trainer.cfg.model.dtype)
-            if cdt != jnp.dtype(self.trainer.cfg.model.param_dtype):
-                if not hasattr(self, "_jit_bcast_cast"):
-                    self._jit_bcast_cast = jax.jit(lambda p: jax.tree.map(
-                        lambda x: x.astype(cdt)
-                        if jnp.issubdtype(x.dtype, jnp.floating) else x, p))
-                params = self._jit_bcast_cast(params)
-            snapshot = jax.device_put(params, self._rollout_shardings)
+            snapshot = jax.device_put(_compute_dtype_params(self),
+                                      self._rollout_shardings)
             with self._weights_lock:
                 self._rollout_params = snapshot
 
@@ -424,49 +494,10 @@ class AsyncOrchestrator:
         raise RuntimeError("rollout worker died") from err
 
     def _sync_rollout_item(self, prompt_iter: Iterator[dict]) -> _Item:
-        """Graceful-degradation rollout: generate ON THE TRAIN MESH
-        with the trainer's own engine (the rollout group's engine
-        belongs to its dead/hung thread and must not be raced).  Slower
-        — the learner stalls for each generation — but the run
-        completes, staleness drops to 0, and every degraded iteration
-        is metrics-tagged."""
-        trainer = self.trainer
-        self.recovery["degraded_iterations"] += 1
-        batch = next(prompt_iter)
-        data_state = prompt_iter.state() \
-            if hasattr(prompt_iter, "state") else None
-        ids, lens, meta = trainer.prepare_prompts(batch)
-        # The update step donates the old param buffers, so the
-        # trainer-side engine must re-sync every iteration here (in
-        # async mode nothing else calls sync_weights).
-        trainer.sync_weights()
-        self._rng, sub = jax.random.split(self._rng)
-        result = trainer.generate(
-            np.asarray(ids), np.asarray(lens), rng=sub,
-            group_size=int(getattr(trainer.cfg, "group_size", 1)))
-        host = result.to_host()
-        scores = trainer._score_result(result, host, meta)
-        return _Item(host._fields(), scores, self._version, data_state)
+        return _sync_rollout_item(self, prompt_iter)
 
     def _quarantine_reason(self, item: _Item) -> Optional[str]:
-        """Non-finite screen over the fields the optimizer consumes:
-        scores (reward path) and behavior logprobs (importance ratio).
-        A NaN here, donated into the update, corrupts the params for
-        every later step — skipping one batch is strictly cheaper."""
-        if not np.isfinite(np.asarray(item.scores)).all():
-            return "scores"
-        lp = item.result_host.get("logprobs")
-        if lp is not None:
-            lp = np.asarray(lp)
-            mask = item.result_host.get("completion_mask")
-            # Screen only REAL completion positions: padded tail slots
-            # may legitimately hold -inf from sampling masks.
-            bad = ~np.isfinite(lp)
-            if mask is not None:
-                bad &= np.asarray(mask, bool)
-            if bad.any():
-                return "logprobs"
-        return None
+        return _quarantine_reason(item)
 
     # ------------------------------------------------------------------
     def train(self, prompt_iter: Iterator[dict],
@@ -503,8 +534,28 @@ class AsyncOrchestrator:
         base0 = self._version
         degraded = False
         worker, stop, hb = self._spawn_worker(prompt_iter, n, base0)
+        preempted = False
+        last_ds = None   # last consumed item's data cursor
         try:
             for it in range(n):
+                # Preemption (resilience.preemption): the previous
+                # step finished cleanly — checkpoint through the
+                # retried-save path and stop, instead of starting work
+                # SIGKILL will tear mid-update.  The saved cursor is
+                # the last consumed item's snapshot (same as every
+                # periodic save): dropping it would make the resumed
+                # run replay prompts from the start of the epoch.
+                if preemption_requested():
+                    preempted = True
+                    self._event("preempt", it)
+                    _LOG.warning(
+                        "preemption requested: stopping the async loop "
+                        "at iteration %d after checkpoint", it)
+                    if trainer.ckpt is not None:
+                        trainer.save_checkpoint(data_state=last_ds,
+                                                eval_iter=eval_iter,
+                                                wait=True)
+                    break
                 prof.step(it)
                 t0 = time.perf_counter()
                 item = None
@@ -522,6 +573,7 @@ class AsyncOrchestrator:
                         item = self._queue.get(timeout=0.1)
                     except queue.Empty:
                         continue
+                last_ds = item.data_state
                 t_wait = time.perf_counter() - t0
                 # Quarantine gate: non-finite scores/logprobs are never
                 # donated into the optimizer — the iteration is spent
@@ -627,7 +679,7 @@ class AsyncOrchestrator:
                         "after stop + 30s join")
         if trainer.ckpt is not None:
             trainer.ckpt.wait()
-        if self._rollout_error is not None:
+        if self._rollout_error is not None and not preempted:
             raise RuntimeError("rollout worker died") from self._rollout_error
         return trainer.metrics_history
 
@@ -637,6 +689,352 @@ class AsyncOrchestrator:
         just in logs."""
         return {
             "rollout_restarts": float(self.recovery["rollout_restarts"]),
+            "quarantined_batches": float(
+                self.recovery["quarantined_batches"]),
+            "degraded_sync_rollout": 1.0 if degraded else 0.0,
+        }
+
+
+class PoolOrchestrator:
+    """Learner-side supervisor for a cross-process rollout-worker pool
+    (the production shape of the decoupled split — ROADMAP open item
+    1, SURVEY.md §5 elastic recovery).
+
+    Where :class:`AsyncOrchestrator` supervises ONE in-process rollout
+    thread, this consumes TRAJ frames from N rollout *processes*
+    through a :class:`~orion_tpu.orchestration.remote.WorkerPool`, and
+    extends PR 5's degradation ladder across the process boundary:
+
+    1. a worker that misses heartbeats or drops its socket is marked
+       dead by the pool; its queued in-flight batches are DISCARDED
+       (never donated to the optimizer) and the remaining workers
+       absorb the load — the round-robin consumer simply rotates past
+       the corpse;
+    2. only an EMPTY pool escalates: the supervisor waits
+       ``resilience.rejoin_grace`` seconds for a (re)join — the
+       cross-process analogue of the restart rung, since the learner
+       cannot respawn a remote process, only re-admit one — then
+       degrades to synchronous rollout on the train mesh
+       (``degrade_to_sync``) or fails fast;
+    3. a preemption notice (``resilience.preemption``) finishes the
+       in-flight step, checkpoints through the retried-save path,
+       GOODBYEs every worker (so they exit gracefully instead of
+       seeing a learner crash), and returns — the caller exits 0.
+
+    Weight broadcast fans the compute-dtype host snapshot to every
+    live worker with a version tag; per-item staleness (learner
+    version − behavior version) lands in the metrics stream exactly as
+    in the in-process orchestrator.
+    """
+
+    def __init__(self, trainer: BaseTrainer, pool=None,
+                 staleness: Optional[int] = None):
+        if not trainer.cfg.async_mode:
+            raise ValueError(
+                "trainer.cfg.async_mode must be True: async trainers "
+                "must use behavior logprobs for the importance ratio")
+        self.trainer = trainer
+        self.rcfg: ResilienceConfig = (
+            getattr(trainer.cfg, "resilience", None) or ResilienceConfig())
+        if staleness is None:
+            staleness = trainer.cfg.async_staleness
+        if staleness < 1:
+            raise ValueError("async_staleness must be >= 1")
+        self.staleness = staleness
+        if pool is None:
+            # Config-driven pool (resilience.rejoin_budget /
+            # heartbeat_timeout / channel_recv_deadline); train() then
+            # waits for resilience.pool_size workers to join before
+            # the first iteration.  Callers that manage their own
+            # membership pass a pool instead.
+            from orion_tpu.orchestration.remote import WorkerPool
+
+            pool = WorkerPool.from_config(self.rcfg)
+            self._own_pool = True
+        else:
+            self._own_pool = False
+        self._quorum_waited = False
+        self.pool = pool
+        # The learner's staleness bound rides every HELLO ack: the
+        # worker-side capacity gate defaults to it, so one config
+        # value governs every worker process.
+        pool.staleness = self.staleness
+        self.events: list = []   # learner-side decisions, in order
+        self.recovery = {"quarantined_batches": 0,
+                         "degraded_iterations": 0}
+        self._version = 0
+        self._rng = jax.random.key(trainer.cfg.seed + 7919)
+        self._broadcast()  # version 0: initial policy for every joiner
+
+    def _event(self, kind: str, detail) -> None:
+        self.events.append((kind, detail))
+
+    # ------------------------------------------------------------------
+    # weight fan-out (learner → every pool worker, host-staged)
+    # ------------------------------------------------------------------
+    def _host_snapshot(self):
+        """Compute-dtype host copy of the policy params for the DCN
+        hop (``_compute_dtype_params`` casts on the train mesh first —
+        same rationale as the in-process broadcast)."""
+        from orion_tpu.orchestration.remote import host_tree
+
+        fault_point("weight_sync")
+        return host_tree(_compute_dtype_params(self))
+
+    def _broadcast(self) -> None:
+        if self.rcfg.weight_sync_attempts > 1:
+            snap = self.rcfg.retry_policy(
+                self.rcfg.weight_sync_attempts,
+                seed=self.trainer.cfg.seed).call(
+                    self._host_snapshot,
+                    on_retry=lambda a, e, d: self._event(
+                        "weight_sync_retry", a))
+        else:
+            snap = self._host_snapshot()
+        # Per-worker send failures are the POOL's problem (a failed
+        # send marks that worker dead); the broadcast itself never
+        # takes the learner down.
+        self.pool.broadcast(snap, self._version)
+
+    # ------------------------------------------------------------------
+    # supervised acquisition
+    # ------------------------------------------------------------------
+    def _next_item(self, it: int, prompt_iter):
+        """(wid, _Item) from the pool, or None when the ladder chose
+        degradation.  Blocks through worker deaths — the survivors
+        absorb the load; only an EMPTY pool escalates."""
+        empty_since = None
+        while True:
+            self.pool.reap_stalled()
+            got = self.pool.next_item(timeout=0.1)
+            if got is not None:
+                member, frame = got
+                payload = frame["item"]
+                return member.wid, _Item(
+                    payload["result"],
+                    np.asarray(payload["scores"], np.float32),
+                    int(frame["version"]),
+                    payload.get("data_state"))
+            if preemption_requested():
+                return None  # handled at the loop top
+            if self.pool.consumable_members():
+                empty_since = None
+                continue
+            now = time.monotonic()
+            if empty_since is None:
+                empty_since = now
+                self._event("pool-empty", it)
+                _LOG.warning(
+                    "worker pool empty at iteration %d; waiting %.1fs "
+                    "for a (re)join before the degradation ladder",
+                    it, self.rcfg.rejoin_grace)
+            if now - empty_since < self.rcfg.rejoin_grace:
+                # next_item returns INSTANTLY on an all-dead pool (no
+                # queue to block on), so without a sleep this loop
+                # busy-spins a learner core for the whole grace window.
+                time.sleep(0.02)
+                continue
+            if self.rcfg.degrade_to_sync and prompt_iter is not None:
+                self._event("degrade", it)
+                _LOG.error(
+                    "worker pool still empty past the %.1fs rejoin "
+                    "grace; degrading to synchronous rollout on the "
+                    "train mesh", self.rcfg.rejoin_grace)
+                return None
+            raise RuntimeError(
+                f"worker pool empty at iteration {it} and still empty "
+                f"after the {self.rcfg.rejoin_grace:.1f}s rejoin grace "
+                "(enable resilience.degrade_to_sync and pass a "
+                "prompt_iter to complete degraded instead)")
+
+    # ------------------------------------------------------------------
+    def train(self, prompt_iter=None,
+              num_iterations: Optional[int] = None,
+              eval_iter=None) -> list:
+        """The pool learner loop.  ``prompt_iter`` feeds ONLY the
+        degraded (train-mesh) path and checkpoint cursors — in pool
+        mode each worker process owns its own prompt shard.  Returns
+        metrics history."""
+        from orion_tpu.rollout import GenerationResult
+        from orion_tpu.trainers.base import _ProfileWindow
+
+        trainer = self.trainer
+        prof = _ProfileWindow(trainer.cfg)
+        if num_iterations is not None:
+            n = num_iterations
+        else:
+            n = max(0, trainer.cfg.total_iterations - trainer.global_iter)
+        degraded = False
+        preempted = False
+        last_ds = None   # last consumed item's data cursor
+        try:
+            if self._own_pool and not self._quorum_waited:
+                # resilience.pool_size: the worker quorum the FIRST
+                # train call waits for.  Elastic after that — more may
+                # join, members may leave/rejoin mid-run, and a later
+                # train() call continues with whatever survived rather
+                # than deadlocking on a full re-quorum.
+                self.pool.wait_for_workers(self.rcfg.pool_size)
+                self._quorum_waited = True
+            for it in range(n):
+                if preemption_requested():
+                    preempted = True
+                    self._event("preempt", it)
+                    break
+                prof.step(it)
+                t0 = time.perf_counter()
+                if degraded:
+                    wid, item = -1, _sync_rollout_item(self, prompt_iter)
+                else:
+                    got = self._next_item(it, prompt_iter)
+                    if got is None:
+                        if preemption_requested():
+                            preempted = True
+                            self._event("preempt", it)
+                            break
+                        degraded = True
+                        wid, item = -1, _sync_rollout_item(self,
+                                                           prompt_iter)
+                    else:
+                        wid, item = got
+                last_ds = item.data_state
+                t_wait = time.perf_counter() - t0
+                quarantine = None
+                if self.rcfg.quarantine_nonfinite:
+                    quarantine = _quarantine_reason(item)
+                if quarantine is not None:
+                    self.recovery["quarantined_batches"] += 1
+                    self._event("quarantine", it)
+                    _LOG.warning(
+                        "quarantined pool batch at iteration %d "
+                        "(non-finite %s, worker %d): update skipped",
+                        it, quarantine, wid)
+                    trainer.global_iter += 1
+                    self._version += 1
+                    if not degraded:
+                        # Unlike the in-process path, the advanced
+                        # version tag must still REACH the workers —
+                        # they stamp future TRAJ frames with the last
+                        # received version, so skipping it would skew
+                        # every later staleness metric by one.  The
+                        # params changed by NOT ONE BYTE (the update
+                        # was skipped), so only the tag ships — never
+                        # the multi-GB snapshot.
+                        self.pool.broadcast_version(self._version)
+                    stats = {
+                        "iteration": it, "quarantined": 1.0,
+                        "worker": float(wid),
+                        "staleness": self._version - 1 - item.version,
+                    }
+                    stats.update(self._recovery_stats(degraded))
+                    trainer.metrics_history.append(stats)
+                    if trainer.writer is not None:
+                        trainer.writer.write(trainer.global_iter, stats)
+                    # Same boundary contract as the in-process path: a
+                    # quarantine landing on an eval/checkpoint boundary
+                    # must not skip it.
+                    if (eval_iter is not None and trainer.cfg.eval_every
+                            and trainer.global_iter
+                            % trainer.cfg.eval_every == 0):
+                        trainer.sync_weights()
+                        trainer._maybe_evaluate(eval_iter)
+                    if trainer.ckpt is not None and trainer.global_iter \
+                            % trainer.cfg.checkpoint_every == 0:
+                        trainer.save_checkpoint(data_state=item.data_state,
+                                                eval_iter=eval_iter)
+                    continue
+                result = GenerationResult(**item.result_host)
+                experience, exp_stats = trainer.build_experience(
+                    result, item.scores)
+                t1 = time.perf_counter()
+                stats = trainer.update_epochs(experience)
+                trainer.global_iter += 1
+                self._version += 1
+                if not degraded:
+                    self._broadcast()
+                if (eval_iter is not None and trainer.cfg.eval_every and
+                        trainer.global_iter %
+                        trainer.cfg.eval_every == 0):
+                    trainer.sync_weights()
+                    trainer._maybe_evaluate(eval_iter)
+                t2 = time.perf_counter()
+                stats.update(exp_stats)
+                n_samples = int(item.result_host["prompt_lens"].shape[0])
+                stats.update({
+                    "iteration": it,
+                    "worker": float(wid),
+                    "staleness": self._version - 1 - item.version,
+                    "time_learner_wait_s": t_wait,
+                    "time_update_s": t2 - t1,
+                    "samples_per_sec": n_samples / (t2 - t0),
+                })
+                stats.update(self._recovery_stats(degraded))
+                trainer.metrics_history.append(stats)
+                if trainer.writer is not None:
+                    trainer.writer.write(trainer.global_iter, stats)
+                if trainer.cfg.log_every and \
+                        it % trainer.cfg.log_every == 0:
+                    trainer.log(stats)
+                if trainer.ckpt is not None and trainer.global_iter \
+                        % trainer.cfg.checkpoint_every == 0:
+                    trainer.save_checkpoint(data_state=item.data_state,
+                                            eval_iter=eval_iter)
+        except BaseException:
+            # An exception escaping train() (empty pool with
+            # degrade_to_sync off, a quorum timeout, an update or
+            # checkpoint failure) must still release a config-built
+            # pool: PoolWorkerClient._wait_capacity deliberately has
+            # no deadline — it relies on the SOCKET dropping — and the
+            # learner process is still alive here, so a leaked pool
+            # leaves every connected worker blocked forever.
+            if self._own_pool:
+                self.pool.shutdown(goodbye=True)
+            raise
+        finally:
+            prof.stop()
+        if preempted:
+            self._preempt_shutdown(eval_iter, last_ds)
+        elif self._own_pool:
+            # The config-built pool's lifecycle belongs to this train
+            # run: release the workers with GOODBYE (a graceful leave,
+            # not a learner crash) — a worker in an unbounded run()
+            # loop otherwise blocks in its capacity gate forever.
+            # Callers needing multiple train() rounds over one pool
+            # pass their own.
+            self.pool.shutdown(goodbye=True)
+        if trainer.ckpt is not None:
+            trainer.ckpt.wait()
+        return trainer.metrics_history
+
+    def _preempt_shutdown(self, eval_iter, data_state=None) -> None:
+        """SIGTERM semantics: the in-flight step already finished (we
+        only stop at iteration boundaries) — checkpoint through the
+        retried-save path, WAIT for it to land (an async write racing
+        process exit is a lost checkpoint), GOODBYE every worker so
+        they exit gracefully, and leave exit-0 to the caller.
+        ``data_state`` is the last consumed item's cursor — saved
+        exactly as the periodic path saves it, so the resumed run does
+        not replay prompts from the start of the epoch."""
+        trainer = self.trainer
+        _LOG.warning(
+            "preemption: checkpointing at global_iter=%d, then "
+            "GOODBYE to %d live workers", trainer.global_iter,
+            len(self.pool.live_members()))
+        if trainer.ckpt is not None:
+            trainer.save_checkpoint(data_state=data_state,
+                                    eval_iter=eval_iter, wait=True)
+        self.pool.shutdown(goodbye=True)
+
+    def _recovery_stats(self, degraded: bool) -> dict:
+        """Pool + learner recovery counters on every metrics row: a
+        worker death must be visible in the stream, not just in
+        logs."""
+        pr = self.pool.recovery
+        return {
+            "worker_deaths": float(pr["worker_deaths"]),
+            "worker_leaves": float(pr["worker_leaves"]),
+            "worker_joins": float(pr["worker_joins"]),
+            "discarded_batches": float(pr["discarded_batches"]),
             "quarantined_batches": float(
                 self.recovery["quarantined_batches"]),
             "degraded_sync_rollout": 1.0 if degraded else 0.0,

@@ -7,9 +7,14 @@ graceful degradation for the async RLHF stack (SURVEY.md §5).
   :class:`CircuitBreaker` (open / half-open probe).
 - :mod:`inject` — the named fault-point registry and seeded
   :class:`FaultPlan` that make chaos runs reproducible.
+- :mod:`preemption` — SIGTERM/SIGINT recorded as a graceful-shutdown
+  request every training loop polls at its iteration boundary
+  (finish the step → checkpoint → GOODBYE → exit 0).
 
 The consumers are the async orchestrator's rollout supervisor
-(restart budget → graceful sync-rollout degradation), the hardened
+(restart budget → graceful sync-rollout degradation), the
+cross-process :class:`~orion_tpu.orchestration.remote.WorkerPool`
+supervisor, the hardened
 :class:`~orion_tpu.utils.checkpoint.CheckpointManager`, the remote
 channel's connect backoff, and the reward paths.
 """
@@ -31,4 +36,11 @@ from orion_tpu.resilience.policy import (  # noqa: F401
     Heartbeat,
     RetryPolicy,
     Watchdog,
+)
+from orion_tpu.resilience.preemption import (  # noqa: F401
+    PreemptionHandler,
+    clear_handler,
+    current_handler,
+    install_handler,
+    preemption_requested,
 )

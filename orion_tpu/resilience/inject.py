@@ -3,7 +3,7 @@
 The production code is instrumented with ``fault_point(name)`` calls at
 every boundary that can fail in the wild (generation dispatch, weight
 sync, the experience queue, checkpoint I/O, reward calls, the remote
-channel).  With no plan installed a fault point is a single global
+channel, and the pool worker's hello/heartbeat/trajectory sends).  With no plan installed a fault point is a single global
 ``None`` check — effectively free.  A chaos run installs a
 :class:`FaultPlan` (via config, env, or the :func:`active_plan` context
 manager) and the named points start raising :class:`InjectedFault` on a
@@ -39,6 +39,9 @@ FAULT_POINTS = frozenset({
     "checkpoint.restore", # orbax restore (inside the fallback walk)
     "reward.call",        # reward_fn invocation in BaseTrainer.score
     "remote.channel",     # PyTreeChannel send/recv
+    "worker.hello",       # pool worker admission handshake
+    "worker.heartbeat",   # pool worker heartbeat send (fires = missed beat)
+    "worker.traj",        # pool worker trajectory send
 })
 
 

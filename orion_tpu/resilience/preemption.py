@@ -1,0 +1,157 @@
+"""Preemption-safe shutdown: SIGTERM/SIGINT → finish the in-flight
+step, checkpoint, say GOODBYE, exit 0 (SURVEY.md §5; ROADMAP
+Resilience "still open" item, now shipped).
+
+On real TPU pods worker preemption is the COMMON failure: the
+scheduler sends SIGTERM, grants a grace window, then SIGKILLs.  The
+contract here:
+
+- :func:`install_handler` swaps in a handler that only RECORDS the
+  signal (an ``Event`` + a count) — signal context does no work;
+- every training loop (``BaseTrainer.train``, ``AsyncOrchestrator``,
+  ``PoolOrchestrator``) polls :func:`preemption_requested` at its
+  iteration boundary: the in-flight step completes, a checkpoint goes
+  through the retried-save path, pool workers get GOODBYE frames (so
+  the learner's departure reads as a graceful leave, never a crash),
+  and the loop returns — the caller exits 0;
+- a SECOND signal escalates: the handler raises ``KeyboardInterrupt``
+  at the next bytecode boundary, for the operator who means *now*.
+
+Handlers are process-global and main-thread-only (a CPython
+restriction on ``signal.signal``); :meth:`PreemptionHandler.request`
+is the programmatic path — deterministic tests and cluster preemption
+notices (borg/k8s API warnings) use it instead of a real signal.
+Pure host code: no jax imports.
+"""
+
+from __future__ import annotations
+
+import logging
+import signal
+import threading
+from typing import List, Optional, Tuple
+
+_LOG = logging.getLogger(__name__)
+
+_HANDLER: Optional["PreemptionHandler"] = None
+
+
+class PreemptionHandler:
+    """Records preemption signals; never acts from signal context."""
+
+    def __init__(self, signals: Tuple[int, ...] = (signal.SIGTERM,
+                                                   signal.SIGINT)):
+        self.signals = tuple(signals)
+        self._event = threading.Event()
+        self.count = 0          # notices, programmatic AND signal
+        self.signal_count = 0   # real OS signals only (escalation key)
+        self.last_signal: Optional[int] = None
+        self._previous: List[Tuple[int, object]] = []
+        self._installed = False
+
+    # -- signal plumbing -------------------------------------------------
+    def install(self) -> "PreemptionHandler":
+        """Swap our recorder in for every configured signal.  Must run
+        on the main thread (CPython restriction); raises ValueError
+        elsewhere — callers on worker threads should use
+        :meth:`request` notices instead."""
+        for sig in self.signals:
+            self._previous.append((sig, signal.signal(sig, self._on_signal)))
+        self._installed = True
+        return self
+
+    def uninstall(self) -> None:
+        for sig, prev in reversed(self._previous):
+            try:
+                signal.signal(sig, prev)
+            except (ValueError, TypeError):  # pragma: no cover
+                pass
+        self._previous.clear()
+        self._installed = False
+
+    def _on_signal(self, signum, frame) -> None:
+        self.count += 1
+        self.signal_count += 1
+        self.last_signal = signum
+        if self.signal_count > 1:
+            # The operator signaled twice: they mean NOW.  Raising out
+            # of the handler aborts the loop at the next bytecode.
+            # Keyed on SIGNALS only: the normal cluster sequence —
+            # an API preemption notice (request()) followed by the
+            # actual SIGTERM — must take the graceful path, not abort
+            # mid-step and lose its checkpoint.
+            raise KeyboardInterrupt(
+                f"second preemption signal ({signum}): forced exit")
+        self._event.set()
+        _LOG.warning(
+            "preemption signal %s received: finishing the in-flight "
+            "step, then checkpoint + graceful shutdown (signal again "
+            "to force)", signum)
+
+    # -- the API loops poll ----------------------------------------------
+    @property
+    def requested(self) -> bool:
+        return self._event.is_set()
+
+    def request(self, signum: Optional[int] = None) -> None:
+        """Programmatic preemption notice (tests, cluster API
+        warnings) — same downstream behavior as a real signal."""
+        self.count += 1
+        if signum is not None:
+            self.last_signal = signum
+        self._event.set()
+
+    def clear(self) -> None:
+        """Reset after a handled (programmatic) notice — lets a
+        supervisor re-arm between runs."""
+        self._event.clear()
+        self.count = 0
+        self.signal_count = 0
+
+
+# ---------------------------------------------------------------------------
+# process-global arming, mirroring the fault-plan slot in inject.py
+# ---------------------------------------------------------------------------
+
+
+def install_handler(signals: Tuple[int, ...] = (signal.SIGTERM,
+                                                signal.SIGINT),
+                    register_signals: bool = True) -> PreemptionHandler:
+    """Install (or return the already-installed) process preemption
+    handler.  ``register_signals=False`` arms only the programmatic
+    :meth:`~PreemptionHandler.request` path — the option for worker
+    threads, where ``signal.signal`` is illegal."""
+    global _HANDLER
+    if _HANDLER is not None:
+        if register_signals and not _HANDLER._installed:
+            # A worker-thread component armed the programmatic-only
+            # handler first; the main-thread caller asking for real
+            # signals must actually GET them — silently returning the
+            # signal-less handler would let SIGTERM hit the default
+            # disposition and kill the process with no checkpoint.
+            _HANDLER.install()
+        return _HANDLER
+    handler = PreemptionHandler(signals)
+    if register_signals:
+        handler.install()
+    _HANDLER = handler
+    return handler
+
+
+def current_handler() -> Optional[PreemptionHandler]:
+    return _HANDLER
+
+
+def clear_handler() -> None:
+    global _HANDLER
+    if _HANDLER is not None:
+        _HANDLER.uninstall()
+        _HANDLER = None
+
+
+def preemption_requested() -> bool:
+    """The one check every training loop polls at its iteration
+    boundary.  No handler installed → False, one attribute load — the
+    same near-zero idle cost contract as ``fault_point``."""
+    handler = _HANDLER
+    return handler is not None and handler.requested

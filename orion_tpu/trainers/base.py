@@ -641,13 +641,16 @@ class BaseTrainer:
         return extra
 
     def save_checkpoint(self, prompt_iter=None, data_state=None,
-                        eval_iter=None) -> None:
+                        eval_iter=None, wait: bool = False) -> None:
+        """``wait=True`` blocks until the write lands — the preemption
+        path's guarantee that exit-0 cannot race the async writer."""
         if self.ckpt is None:
             raise ValueError("configure checkpoint_dir + checkpoint_every")
         self.ckpt.save(self.global_iter, self.state,
                        critic_state=getattr(self, "critic_state", None),
                        extra=self._extra_state(prompt_iter, data_state,
-                                               eval_iter))
+                                               eval_iter),
+                       wait=wait)
 
     def resume(self, prompt_iter=None, eval_iter=None) -> bool:
         """Restore the latest checkpoint if one exists.  Returns True if
@@ -707,10 +710,27 @@ class BaseTrainer:
         # next build_experience).
         from orion_tpu.analysis.runtime_guards import guard_scope
 
+        from orion_tpu.resilience import preemption_requested
+
         pending = None
         self._defer_stats = True
         try:
             for it in range(n):
+                # Preemption (resilience.preemption): the in-flight
+                # step finished — flush its stats, checkpoint through
+                # the retried-save path (waited: exit-0 must not race
+                # the async writer), and stop cleanly.
+                if preemption_requested():
+                    if pending is not None:
+                        fetched = jax.device_get(pending["dev"])
+                        self._finalize_iteration(pending, fetched,
+                                                 now=time.perf_counter())
+                        pending = None
+                    if self.ckpt is not None:
+                        self.save_checkpoint(prompt_iter,
+                                             eval_iter=eval_iter,
+                                             wait=True)
+                    break
                 prof.step(it)
                 t0 = time.perf_counter()
                 batch = next(prompt_iter)

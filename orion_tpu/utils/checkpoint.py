@@ -58,7 +58,11 @@ class CheckpointManager:
 
     # ------------------------------------------------------------------
     def save(self, step: int, state: Any, critic_state: Any = None,
-             extra: Optional[dict] = None) -> None:
+             extra: Optional[dict] = None, wait: bool = False) -> None:
+        """``wait=True`` blocks until the (normally async) write has
+        fully landed — the preemption-shutdown contract: a SIGTERM'd
+        learner that exits 0 right after ``save`` must never leave the
+        checkpoint half-staged on the background writer."""
         # Device-side snapshot before handing to the async writer: the
         # trainer's next update step *donates* the state buffers, and a
         # donated buffer is deleted even while orbax still references it
@@ -87,6 +91,8 @@ class CheckpointManager:
         self._save_retry.call(_write, on_retry=lambda a, e, d: _LOG.warning(
             "checkpoint save step %d failed (attempt %d: %r); "
             "retrying in %.2fs", step, a, e, d))
+        if wait:
+            self.wait()
 
     def restore(self, step: Optional[int] = None, state_template: Any = None,
                 critic_template: Any = None) -> dict:

@@ -430,6 +430,40 @@ FIXTURES = [
         """,
         "orion_tpu/fake_worker2.py",
     ),
+    (
+        "raw-socket",
+        """
+        import socket
+
+        def dial(host, port):
+            return socket.create_connection((host, port))
+        """,
+        """
+        from orion_tpu.orchestration.remote import PyTreeChannel
+
+        def dial(port):
+            return PyTreeChannel.connect(port)
+        """,
+        "orion_tpu/fake_io.py",
+    ),
+    (
+        "raw-socket",
+        """
+        import socket
+
+        def serve():
+            s = socket.socket()
+            s.bind(("localhost", 0))
+            return s
+        """,
+        """
+        from orion_tpu.orchestration.remote import WorkerPool
+
+        def serve():
+            return WorkerPool(0)
+        """,
+        "orion_tpu/fake_io.py",
+    ),
 ]
 
 
@@ -451,7 +485,21 @@ def test_every_rule_has_fixture_coverage():
     covered = {r for r, *_ in FIXTURES}
     assert covered == {r.id for r in RULES}, \
         "each registered rule needs a positive+negative fixture here"
-    assert len(RULES) >= 8
+    assert len(RULES) >= 10
+
+
+def test_raw_socket_allowed_only_in_remote_py():
+    """The one module allowed to touch sockets IS the hardened
+    channel — the same snippet fires everywhere else."""
+    snippet = """
+    import socket
+
+    def dial(port):
+        return socket.create_connection(("localhost", port))
+    """
+    assert "raw-socket" in ids_of(run_on(snippet, "orion_tpu/fake.py"))
+    assert "raw-socket" not in ids_of(
+        run_on(snippet, "orion_tpu/orchestration/remote.py"))
 
 
 # ---------------------------------------------------------------------------

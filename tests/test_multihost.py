@@ -71,7 +71,7 @@ jax.distributed.shutdown()
 def _run_two_process(worker_src, timeout=420):
     """Launch two coordinator-joined worker processes running
     ``worker_src`` and collect their RESULT lines."""
-    with socket.socket() as s:
+    with socket.socket() as s:  # orion: ignore[raw-socket] free-port probe, no IO
         s.bind(("localhost", 0))
         port = s.getsockname()[1]
     coord = f"localhost:{port}"

@@ -1,0 +1,962 @@
+"""Elastic rollout-worker pool (SURVEY.md §5 "failure detection /
+elastic recovery"; ROADMAP open item 1): the framed channel protocol,
+cross-process supervision, preemption-safe shutdown.
+
+Fast path (tier-1): the pool runs IN-PROCESS — worker threads speak
+the real TCP protocol through real PoolWorkerClient instances, so the
+supervisor logic (join/leave/rejoin, heartbeat death, in-flight
+discard, round-robin determinism, the empty-pool ladder, preemption)
+is covered without subprocess cost.  The ``slow``-marked tests at the
+bottom spawn REAL worker subprocesses and SIGKILL/SIGTERM them.
+"""
+
+import os
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from orion_tpu.config import GRPOConfig, ResilienceConfig
+from orion_tpu.models import Transformer, init_params
+from orion_tpu.orchestration import (PoolOrchestrator, PoolWorkerClient,
+                                     WorkerPool)
+from orion_tpu.orchestration.remote import (MAGIC, PROTOCOL_VERSION,
+                                            _HEADER, ProtocolError,
+                                            PyTreeChannel)
+from orion_tpu.resilience import (FaultPlan, active_plan, clear_handler,
+                                  install_handler)
+from orion_tpu.trainers import GRPOTrainer
+
+from test_trainers import (VOCAB, lucky_token_reward, prompt_stream, _mk,
+                           tiny_model_cfg)
+
+K = 2     # group size
+P = 4     # prompt length
+T = 8     # max_new_tokens (the _mk rollout default)
+LUCKY = 7
+
+
+def _free_port() -> int:
+    s = socket.socket()  # orion: ignore[raw-socket] free-port probe, no IO
+    s.bind(("localhost", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+# ---------------------------------------------------------------------------
+# channel protocol hardening
+# ---------------------------------------------------------------------------
+
+
+def _raw_connect(port: int, timeout: float = 15.0) -> socket.socket:
+    """Plain TCP connect with retry (the listener thread may not have
+    bound yet) — used to simulate NON-channel peers."""
+    deadline = time.monotonic() + timeout
+    while True:
+        try:
+            return socket.create_connection(("localhost", port))  # orion: ignore[raw-socket] stray-peer simulation against the channel itself
+        except OSError:
+            if time.monotonic() > deadline:
+                raise
+            time.sleep(0.02)
+
+
+def _channel_pair(recv_deadline: float = 0.0):
+    port = _free_port()
+    out = {}
+    t = threading.Thread(target=lambda: out.update(
+        a=PyTreeChannel.listen(port, timeout=20,
+                               recv_deadline=recv_deadline)))
+    t.start()
+    b = PyTreeChannel.connect(port, timeout=20,
+                              recv_deadline=recv_deadline)
+    t.join(timeout=20)
+    return out["a"], b
+
+
+def test_keepalive_and_frame_roundtrip():
+    a, b = _channel_pair()
+    try:
+        for chan in (a, b):
+            assert chan._sock.getsockopt(
+                socket.SOL_SOCKET, socket.SO_KEEPALIVE) == 1, \
+                "SO_KEEPALIVE must be on: a silently dead peer must " \
+                "not hang recv() forever"
+        a.send({"x": np.arange(3)})
+        np.testing.assert_array_equal(b.recv()["x"], np.arange(3))
+    finally:
+        a.close()
+        b.close()
+
+
+def test_bad_magic_raises_protocol_error():
+    """A stray peer (health checker, port scanner, HTTP client) fails
+    with a clear ProtocolError, not an opaque pickle/length blowup."""
+    port = _free_port()
+    out = {}
+    t = threading.Thread(target=lambda: out.update(
+        chan=PyTreeChannel.listen(port, timeout=20)))
+    t.start()
+    raw = _raw_connect(port)
+    t.join(timeout=20)
+    try:
+        raw.sendall(b"GET / HTTP/1.0\r\n\r\n" + b"\x00" * 16)
+        with pytest.raises(ProtocolError, match="bad magic"):
+            out["chan"].recv_frame()
+    finally:
+        raw.close()
+        out["chan"].close()
+
+
+def test_version_mismatch_raises_protocol_error():
+    port = _free_port()
+    out = {}
+    t = threading.Thread(target=lambda: out.update(
+        chan=PyTreeChannel.listen(port, timeout=20)))
+    t.start()
+    raw = _raw_connect(port)
+    t.join(timeout=20)
+    try:
+        raw.sendall(_HEADER.pack(MAGIC, PROTOCOL_VERSION + 1, 0, 0))
+        with pytest.raises(ProtocolError, match="version mismatch"):
+            out["chan"].recv_frame()
+    finally:
+        raw.close()
+        out["chan"].close()
+
+
+def test_recv_idle_deadline_raises_instead_of_hanging():
+    a, b = _channel_pair(recv_deadline=0.3)
+    try:
+        t0 = time.monotonic()
+        with pytest.raises(TimeoutError, match="idle"):
+            a.recv()
+        assert time.monotonic() - t0 < 5.0
+        # the zero default still blocks (and survives a slow sender)
+        assert b.recv_deadline == 0.3
+    finally:
+        a.close()
+        b.close()
+
+
+# ---------------------------------------------------------------------------
+# pool membership: join / leave / rejoin / heartbeat death / discard
+# ---------------------------------------------------------------------------
+
+
+def _fake_payload(rng: np.random.RandomState) -> dict:
+    """A deterministic GenerationResult-shaped trajectory batch (B =
+    2 prompts × k clones).  Content is independent of params/version,
+    which is what makes the seeded replay test bit-exact."""
+    B = 2 * K
+    seq = rng.randint(1, VOCAB, (B, P + T)).astype(np.int32)
+    comp = seq[:, P:].copy()
+    mask = np.ones((B, T), np.float32)
+    lp = -np.abs(rng.randn(B, T)).astype(np.float32)
+    result = dict(
+        sequences=seq, completions=comp, completion_mask=mask,
+        completion_lens=np.full(B, T, np.int32),
+        logprobs=lp, policy_logprobs=lp.copy(),
+        prompt_lens=np.full(B, P, np.int32),
+        total_lens=np.full(B, P + T, np.int32))
+    scores = ((comp == LUCKY) * mask).sum(1).astype(np.float32)
+    return {"result": result, "scores": scores}
+
+
+class FakeWorker:
+    """A thread standing in for a rollout process, speaking the real
+    TCP pool protocol through a real PoolWorkerClient."""
+
+    def __init__(self, port: int, rank: int, n_batches=None,
+                 fail_at=None, staleness: int = 1):
+        self.rank = rank
+        self.sent = None
+        self.error = None
+        self.client = None
+        self._ready = threading.Event()
+
+        def target():
+            try:
+                self.client = PoolWorkerClient(
+                    port, name=f"fake-{rank}", heartbeat_interval=0.05,
+                    connect_timeout=20, seed=rank)
+                self._ready.set()
+                rng = np.random.RandomState(1000 + rank)
+
+                def gen(i, version, params):
+                    if fail_at is not None and i + 1 == fail_at:
+                        raise RuntimeError(
+                            f"synthetic crash in worker {rank}")
+                    return _fake_payload(rng)
+
+                self.sent = self.client.run(gen, n_batches,
+                                            staleness=staleness)
+            except BaseException as e:  # crash semantics under test
+                self.error = e
+                self._ready.set()
+
+        self.thread = threading.Thread(target=target, daemon=True)
+        self.thread.start()
+
+    def join(self, timeout=20.0):
+        self.thread.join(timeout=timeout)
+        assert not self.thread.is_alive(), "fake worker thread leaked"
+
+
+def test_pool_join_roundrobin_leave_and_rejoin():
+    pool = WorkerPool(0, heartbeat_timeout=5.0, rejoin_budget=4)
+    try:
+        pool.broadcast({"w": np.ones(1)}, 0)
+        w0 = FakeWorker(pool.port, 0, n_batches=2)
+        _wait_until(lambda: pool.recovery["worker_joins"] == 1,
+                    msg="w0 to join")
+        w1 = FakeWorker(pool.port, 1, n_batches=2)
+        _wait_until(lambda: pool.recovery["worker_joins"] == 2,
+                    msg="w1 to join")
+        # Round-robin consumption in admission order — the
+        # deterministic-replay witness.
+        wids = []
+        for _ in range(4):
+            got = pool.next_item(timeout=20.0)
+            assert got is not None
+            member, frame = got
+            wids.append(member.wid)
+            assert frame["worker"] == member.wid
+        assert wids == [0, 1, 0, 1], wids
+        w0.join()
+        w1.join()
+        assert w0.error is None and w1.error is None
+        _wait_until(lambda: pool.recovery["worker_leaves"] == 2)
+        assert pool.recovery["worker_deaths"] == 0
+        # mid-run REJOIN: a new worker is admitted after departures
+        w2 = FakeWorker(pool.port, 2, n_batches=1)
+        _wait_until(lambda: pool.recovery["worker_joins"] == 3,
+                    msg="w2 to rejoin")
+        got = pool.next_item(timeout=20.0)
+        assert got is not None and got[0].wid == 2
+        w2.join()
+        kinds = [k for k, _ in pool.events]
+        assert kinds.count("worker-join") == 3
+        assert kinds.count("worker-leave") == 3 or \
+            pool.recovery["worker_leaves"] >= 2
+    finally:
+        pool.shutdown()
+
+
+def _wait_until(cond, timeout=10.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while not cond():
+        if time.monotonic() > deadline:
+            raise AssertionError(f"timed out waiting for {msg}")
+        time.sleep(0.02)
+
+
+def test_generate_fn_oserror_is_a_crash_not_learner_gone():
+    """OSError/ConnectionError raised by CALLER code (reward service
+    down, missing data shard) is a worker CRASH — ``run()`` must
+    re-raise it so the process supervisor sees a failure, not swallow
+    it as a graceful learner-gone exit 0.  The learner side sees the
+    socket drop with no GOODBYE: a death."""
+    pool = WorkerPool(0, heartbeat_timeout=30.0)
+    try:
+        pool.broadcast({"w": np.ones(1)}, 0)
+        err = {}
+
+        def target():
+            client = PoolWorkerClient(pool.port, name="oserr",
+                                      heartbeat_interval=0.05,
+                                      connect_timeout=20, seed=0)
+
+            def gen(i, version, params):
+                raise FileNotFoundError("prompt shard missing")
+
+            try:
+                client.run(gen, 1, staleness=1)
+            except BaseException as e:
+                err["e"] = e
+
+        t = threading.Thread(target=target, daemon=True)
+        t.start()
+        t.join(timeout=20)
+        assert not t.is_alive(), "worker thread leaked"
+        assert isinstance(err.get("e"), FileNotFoundError), err
+        _wait_until(lambda: pool.recovery["worker_deaths"] == 1,
+                    msg="learner to see the crash as a death")
+        assert pool.recovery["worker_leaves"] == 0
+    finally:
+        pool.shutdown(goodbye=False)
+
+
+def test_rejoin_budget_refuses_flapping_worker():
+    pool = WorkerPool(0, heartbeat_timeout=5.0, rejoin_budget=1)
+    try:
+        pool.broadcast({"w": np.ones(1)}, 0)
+        w0 = FakeWorker(pool.port, 0, n_batches=1)
+        _wait_until(lambda: pool.recovery["worker_joins"] == 1,
+                    msg="w0 to join")
+        assert pool.next_item(timeout=20.0) is not None
+        w0.join()
+        _wait_until(lambda: pool.recovery["worker_leaves"] == 1)
+        # rejoin 1/1: admitted
+        w1 = FakeWorker(pool.port, 1, n_batches=1)
+        _wait_until(lambda: pool.recovery["worker_joins"] == 2,
+                    msg="w1 to rejoin")
+        assert pool.next_item(timeout=20.0) is not None
+        w1.join()
+        _wait_until(lambda: pool.recovery["worker_leaves"] == 2)
+        # rejoin 2 > budget 1: refused with a clear error
+        with pytest.raises(ConnectionError, match="refused"):
+            PoolWorkerClient(pool.port, name="flapper",
+                             connect_timeout=20)
+        assert pool.recovery["worker_refused"] >= 1
+    finally:
+        pool.shutdown()
+
+
+def test_heartbeat_silence_marks_dead_and_discards_inflight():
+    """A live-but-wedged worker: heartbeats stop, the socket stays
+    open.  The watchdog reaps it and its queued (in-flight) batches
+    are discarded — never donated to the optimizer."""
+    pool = WorkerPool(0, heartbeat_timeout=0.4)
+    try:
+        pool.broadcast({}, 0)
+        client = PoolWorkerClient(pool.port, name="wedged",
+                                  heartbeat_interval=0.05,
+                                  connect_timeout=20)
+        pool.wait_for_workers(1, timeout=20)
+        rng = np.random.RandomState(0)
+        client.send_traj(_fake_payload(rng), 0)
+        client.send_traj(_fake_payload(rng), 0)
+        _wait_until(lambda: pool.live_members()[0].produced == 2)
+        # wedge: stop the heartbeat sender, keep the socket open
+        client.closed.set()
+        time.sleep(0.9)
+        reaped = pool.reap_stalled()
+        assert reaped == [0], reaped
+        assert pool.recovery["worker_deaths"] == 1
+        assert pool.recovery["discarded_batches"] == 2
+        assert pool.next_item(timeout=0.3) is None
+        assert ("worker-death", (0, 2)) in pool.events
+    finally:
+        pool.shutdown()
+
+
+def test_crash_discards_backlog_but_goodbye_keeps_it():
+    pool = WorkerPool(0, heartbeat_timeout=5.0)
+    try:
+        pool.broadcast({}, 0)
+        rng = np.random.RandomState(0)
+        crasher = PoolWorkerClient(pool.port, name="crasher",
+                                   connect_timeout=20)
+        pool.wait_for_workers(1, timeout=20)
+        crasher.send_traj(_fake_payload(rng), 0)
+        _wait_until(lambda: pool.live_members()[0].produced == 1)
+        crasher.close()  # socket drop, NO goodbye → crash
+        _wait_until(lambda: pool.recovery["worker_deaths"] == 1)
+        assert pool.recovery["discarded_batches"] == 1
+        assert pool.next_item(timeout=0.3) is None
+
+        leaver = PoolWorkerClient(pool.port, name="leaver",
+                                  connect_timeout=20)
+        pool.wait_for_workers(1, timeout=20)
+        leaver.send_traj(_fake_payload(rng), 0)
+        _wait_until(
+            lambda: any(m.produced == 1 for m in pool.live_members()))
+        leaver.leave()  # graceful → backlog stays consumable
+        _wait_until(lambda: pool.recovery["worker_leaves"] == 1)
+        got = pool.next_item(timeout=5.0)
+        assert got is not None and got[0].name == "leaver"
+    finally:
+        pool.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# supervisor: the pool learner loop
+# ---------------------------------------------------------------------------
+
+
+def _mk_trainer(tmp_path, checkpoint_every=2, **res_kw):
+    cfg = _mk(GRPOConfig, group_size=K, kl_coef=0.0, num_epochs=1,
+              async_mode=True, async_staleness=1, seed=0,
+              minibatch_size=2 * K,
+              checkpoint_dir=str(tmp_path / "ckpt"),
+              checkpoint_every=checkpoint_every,
+              resilience=ResilienceConfig(**res_kw))
+    model = Transformer(cfg.model)
+    params = init_params(model, jax.random.key(0), cfg.model)
+    trainer = GRPOTrainer(cfg, model, params,
+                          reward_fn=lucky_token_reward, eos_token_id=None)
+    return cfg, trainer
+
+
+class RealWorker:
+    """Thread worker with a REAL RolloutEngine: generates with the
+    broadcast weights, scores host-side — the full rollout-process
+    pipeline minus the process boundary."""
+
+    def __init__(self, port: int, rank: int):
+        self.rank = rank
+        self.sent = None
+        self.error = None
+
+        def target():
+            try:
+                from orion_tpu.rollout.engine import RolloutEngine
+
+                mcfg = tiny_model_cfg()
+                model = Transformer(mcfg)
+                cfg = _mk(GRPOConfig)  # for the rollout sub-config only
+                eng = RolloutEngine(model, mcfg, cfg.rollout,
+                                    eos_token_id=None, pad_token_id=0)
+                client = PoolWorkerClient(
+                    port, name=f"real-{rank}", heartbeat_interval=0.1,
+                    connect_timeout=20, seed=rank)
+                stream = prompt_stream(2, P, seed=50 + rank)
+
+                def gen(i, version, params_host):
+                    batch = next(stream)
+                    ids = np.repeat(
+                        np.asarray(batch["prompt_ids"], np.int32), K, 0)
+                    lens = np.repeat(
+                        np.asarray(batch["prompt_lens"], np.int32), K)
+                    params = jax.device_put(params_host)
+                    rng = jax.random.fold_in(
+                        jax.random.key(777 + rank), i)
+                    host = eng.generate(ids, lens, rng,
+                                        params=params).to_host()
+                    return {"result": host._fields(),
+                            "scores": lucky_token_reward(host, {})}
+
+                self.sent = client.run(gen, None, staleness=1)
+            except BaseException as e:
+                self.error = e
+
+        self.thread = threading.Thread(target=target, daemon=True)
+        self.thread.start()
+
+
+def test_pool_supervisor_trains_with_two_real_workers(tmp_path):
+    cfg, trainer = _mk_trainer(tmp_path, checkpoint_every=100)
+    pool = WorkerPool(0, heartbeat_timeout=30.0)
+    try:
+        orch = PoolOrchestrator(trainer, pool)
+        w0 = RealWorker(pool.port, 0)
+        pool.wait_for_workers(1, timeout=60)
+        w1 = RealWorker(pool.port, 1)
+        pool.wait_for_workers(2, timeout=60)
+        history = orch.train(prompt_stream(2, P), num_iterations=4)
+        assert len(history) == 4 and trainer.global_iter == 4
+        # round-robin: both processes' experience trained
+        assert {h["worker"] for h in history} == {0.0, 1.0}
+        for h in history:
+            assert np.isfinite(h["loss"])
+            assert 0 <= h["staleness"], h
+            assert h["worker_deaths"] == 0.0
+    finally:
+        pool.shutdown(goodbye=True)
+    for w in (w0, w1):
+        w.thread.join(timeout=30)
+        assert not w.thread.is_alive() and w.error is None
+
+
+def test_worker_death_midrun_survivor_absorbs_load(tmp_path):
+    """One of two workers dies mid-run (socket dropped, no GOODBYE):
+    the learner completes all iterations on the survivor and the death
+    is visible in the metrics recovery counters."""
+    cfg, trainer = _mk_trainer(tmp_path, checkpoint_every=100)
+    pool = WorkerPool(0, heartbeat_timeout=30.0)
+    try:
+        orch = PoolOrchestrator(trainer, pool)
+        w0 = FakeWorker(pool.port, 0, fail_at=3)  # crashes on batch 3
+        pool.wait_for_workers(1, timeout=20)
+        w1 = FakeWorker(pool.port, 1)
+        pool.wait_for_workers(2, timeout=20)
+        history = orch.train(prompt_stream(2, P), num_iterations=6)
+        assert len(history) == 6 and trainer.global_iter == 6
+        assert pool.recovery["worker_deaths"] == 1
+        assert history[-1]["worker_deaths"] == 1.0
+        # the survivor carried the tail
+        assert sum(1 for h in history if h["worker"] == 1.0) >= 4
+        assert all(np.isfinite(h["loss"]) for h in history)
+        assert any(k == "worker-death" for k, _ in pool.events)
+        w0.thread.join(timeout=20)
+        assert isinstance(w0.error, RuntimeError)
+    finally:
+        pool.shutdown(goodbye=True)
+        w1.thread.join(timeout=20)
+
+
+def _seeded_chaos_run(tmp_path, sub):
+    """One seeded pool chaos run: a single worker is killed by the
+    FaultPlan on its 3rd trajectory send; the empty pool waits out the
+    rejoin grace, then the ladder degrades to sync rollout on the
+    train mesh and the run completes.  staleness=0 on the worker keeps
+    its queue empty at death (each batch is consumed before the next
+    is generated), so the consumed-item sequence — and therefore every
+    loss — is bit-identical across replays."""
+    plan = FaultPlan({"worker.traj": {"at": 3}}, seed=0)
+    cfg, trainer = _mk_trainer(tmp_path / sub, checkpoint_every=100,
+                               degrade_to_sync=True, rejoin_grace=0.3)
+    pool = WorkerPool(0, heartbeat_timeout=30.0)
+    try:
+        with active_plan(plan):
+            orch = PoolOrchestrator(trainer, pool)
+            w = FakeWorker(pool.port, 0, staleness=0)
+            pool.wait_for_workers(1, timeout=20)
+            history = orch.train(prompt_stream(2, P, seed=9),
+                                 num_iterations=6)
+        w.thread.join(timeout=20)
+    finally:
+        pool.shutdown()
+    return plan, trainer, orch, pool, history
+
+
+def test_pool_chaos_replay_is_bit_identical(tmp_path):
+    """Acceptance criterion: a pool run with a worker killed mid-run
+    by a seeded FaultPlan completes, records the death, and a replay
+    of the same plan reproduces the identical fault sequence, recovery
+    events, AND losses."""
+    p1, t1, o1, pool1, h1 = _seeded_chaos_run(tmp_path, "a")
+    p2, t2, o2, pool2, h2 = _seeded_chaos_run(tmp_path, "b")
+    assert p1.events == p2.events == [("worker.traj", 3)]
+    assert t1.global_iter == t2.global_iter == 6
+    for o, pool, h in ((o1, pool1, h1), (o2, pool2, h2)):
+        assert pool.recovery["worker_deaths"] == 1
+        assert pool.recovery["discarded_batches"] == 0
+        assert o.recovery["degraded_iterations"] == 4
+        kinds = [k for k, _ in o.events]
+        assert "pool-empty" in kinds and "degrade" in kinds
+        assert h[-1]["degraded_sync_rollout"] == 1.0
+        assert h[-1]["worker_deaths"] == 1.0
+    assert [k for k, _ in o1.events] == [k for k, _ in o2.events]
+    np.testing.assert_array_equal(
+        np.asarray([h["loss"] for h in h1]),
+        np.asarray([h["loss"] for h in h2]))
+    np.testing.assert_array_equal(
+        np.asarray([h["staleness"] for h in h1]),
+        np.asarray([h["staleness"] for h in h2]))
+
+
+def test_empty_pool_fail_fast_without_degrade(tmp_path):
+    """Graceful-leave backlog is consumed first; THEN the empty pool
+    (past the rejoin grace, no degrade configured) fails fast."""
+    cfg, trainer = _mk_trainer(tmp_path, checkpoint_every=100,
+                               degrade_to_sync=False, rejoin_grace=0.2)
+    pool = WorkerPool(0, heartbeat_timeout=30.0)
+    try:
+        orch = PoolOrchestrator(trainer, pool)
+        w = FakeWorker(pool.port, 0, n_batches=2)
+        _wait_until(lambda: pool.recovery["worker_joins"] == 1,
+                    msg="w0 to join")
+        with pytest.raises(RuntimeError, match="worker pool empty"):
+            orch.train(prompt_stream(2, P), num_iterations=6)
+        # both pre-leave batches were trained before the ladder fired
+        assert trainer.global_iter == 2
+        assert pool.recovery["worker_leaves"] == 1
+        w.join()
+    finally:
+        pool.shutdown()
+
+
+def test_midrun_join_keeps_run_alive(tmp_path):
+    """Elastic membership: the first worker leaves after 2 batches; a
+    replacement joins mid-run inside the rejoin grace and the learner
+    finishes without degrading."""
+    cfg, trainer = _mk_trainer(tmp_path, checkpoint_every=100,
+                               degrade_to_sync=False, rejoin_grace=30.0)
+    pool = WorkerPool(0, heartbeat_timeout=30.0)
+    spawned = {}
+    try:
+        orch = PoolOrchestrator(trainer, pool)
+        w0 = FakeWorker(pool.port, 0, n_batches=2)
+        _wait_until(lambda: pool.recovery["worker_joins"] == 1,
+                    msg="w0 to join")
+
+        def late_join():
+            _wait_until(lambda: pool.recovery["worker_leaves"] == 1,
+                        timeout=60, msg="first worker to leave")
+            spawned["w1"] = FakeWorker(pool.port, 1)
+
+        joiner = threading.Thread(target=late_join, daemon=True)
+        joiner.start()
+        history = orch.train(prompt_stream(2, P), num_iterations=5)
+        assert len(history) == 5 and trainer.global_iter == 5
+        assert pool.recovery["worker_joins"] == 2
+        assert {h["worker"] for h in history} == {0.0, 1.0}
+        assert orch.recovery["degraded_iterations"] == 0
+        w0.join()
+        joiner.join(timeout=20)
+    finally:
+        pool.shutdown(goodbye=True)
+        if "w1" in spawned:
+            spawned["w1"].thread.join(timeout=20)
+
+
+def test_config_knobs_drive_pool_and_client(tmp_path):
+    """The ResilienceConfig pool knobs are wired, not decorative:
+    PoolOrchestrator with no pool builds one from config
+    (rejoin_budget, heartbeat_timeout, channel_recv_deadline), waits
+    for ``pool_size`` workers at train start, and the learner's
+    async_staleness bound rides the HELLO ack into
+    ``PoolWorkerClient.run``'s default capacity gate."""
+    cfg, trainer = _mk_trainer(tmp_path, checkpoint_every=100,
+                               pool_size=1, rejoin_budget=2,
+                               heartbeat_interval=0.05,
+                               heartbeat_timeout=30.0,
+                               channel_recv_deadline=20.0)
+    orch = PoolOrchestrator(trainer)  # no pool: built from config
+    pool = orch.pool
+    try:
+        assert orch._own_pool
+        assert pool.rejoin_budget == 2
+        assert pool.heartbeat_timeout == 30.0
+        assert pool.recv_deadline == 20.0
+        assert pool.staleness == 1  # cfg.async_staleness
+        box = {}
+
+        def worker():
+            client = PoolWorkerClient.from_config(
+                cfg.resilience, pool.port, name="cfg-w", seed=0)
+            box["client"] = client
+            rng = np.random.RandomState(7)
+            box["sent"] = client.run(
+                lambda i, v, p: _fake_payload(rng), n_batches=3)
+
+        t = threading.Thread(target=worker, daemon=True)
+        t.start()
+        history = orch.train(prompt_stream(2, P), num_iterations=3)
+        assert len(history) == 3 and trainer.global_iter == 3
+        t.join(timeout=20)
+        assert not t.is_alive() and box["sent"] == 3
+        client = box["client"]
+        assert client.heartbeat_interval == 0.05
+        assert client.chan.recv_deadline == 20.0
+        assert client.learner_staleness == 1
+    finally:
+        pool.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# preemption: SIGTERM → finish step → checkpoint → GOODBYE → exit 0
+# ---------------------------------------------------------------------------
+
+
+def test_preemption_handler_records_then_escalates():
+    handler = install_handler(signals=(signal.SIGTERM,))
+    try:
+        assert not handler.requested
+        os.kill(os.getpid(), signal.SIGTERM)
+        time.sleep(0.1)
+        assert handler.requested and handler.count == 1
+        assert handler.last_signal == signal.SIGTERM
+        with pytest.raises(KeyboardInterrupt, match="forced exit"):
+            os.kill(os.getpid(), signal.SIGTERM)
+            time.sleep(0.5)
+    finally:
+        clear_handler()
+
+
+def test_sync_trainer_preemption_checkpoints_and_stops(tmp_path):
+    """BaseTrainer.train: a preemption notice lands mid-run → the
+    in-flight iteration finishes, its deferred stats flush, a WAITED
+    checkpoint saves, and a rebuilt trainer resumes from it."""
+    handler = install_handler(register_signals=False)
+    try:
+        cfg = _mk(GRPOConfig, group_size=K, kl_coef=0.0, num_epochs=1,
+                  seed=0, minibatch_size=2 * K,
+                  checkpoint_dir=str(tmp_path / "ckpt"),
+                  checkpoint_every=100)
+        model = Transformer(cfg.model)
+        params = init_params(model, jax.random.key(0), cfg.model)
+        trainer = GRPOTrainer(cfg, model, params,
+                              reward_fn=lucky_token_reward,
+                              eos_token_id=None)
+        base = prompt_stream(2, P)
+
+        def stream():
+            i = 0
+            while True:
+                i += 1
+                if i == 3:  # fires during iteration 2's batch fetch
+                    handler.request(signal.SIGTERM)
+                yield next(base)
+
+        history = trainer.train(stream(), num_iterations=8)
+        assert trainer.global_iter == 3, "finish the in-flight step, " \
+            "then stop at the NEXT iteration boundary"
+        assert len(history) == 3  # the deferred stats were flushed
+        assert trainer.ckpt.latest_step() == 3
+
+        model2 = Transformer(cfg.model)
+        params2 = init_params(model2, jax.random.key(1), cfg.model)
+        trainer2 = GRPOTrainer(cfg, model2, params2,
+                               reward_fn=lucky_token_reward,
+                               eos_token_id=None)
+        assert trainer2.resume()
+        assert trainer2.global_iter == 3
+    finally:
+        clear_handler()
+
+
+def test_pool_preemption_checkpoints_and_goodbyes(tmp_path):
+    """PoolOrchestrator: preemption finishes the in-flight step, saves
+    a restorable checkpoint through the retried-save path, and the
+    worker receives GOODBYE (graceful leave, not a learner crash)."""
+    handler = install_handler(register_signals=False)
+    cfg, trainer = _mk_trainer(tmp_path, checkpoint_every=100)
+    pool = WorkerPool(0, heartbeat_timeout=30.0)
+    try:
+        orch = PoolOrchestrator(trainer, pool)
+        w = FakeWorker(pool.port, 0)
+        pool.wait_for_workers(1, timeout=20)
+
+        def notice():
+            _wait_until(lambda: trainer.global_iter >= 2, timeout=120,
+                        msg="two pool iterations")
+            handler.request(signal.SIGTERM)
+
+        notifier = threading.Thread(target=notice, daemon=True)
+        notifier.start()
+        history = orch.train(prompt_stream(2, P), num_iterations=50)
+        notifier.join(timeout=20)
+        assert 2 <= trainer.global_iter < 50
+        assert any(k == "preempt" for k, _ in orch.events)
+        assert trainer.ckpt.latest_step() == trainer.global_iter
+        # worker exited gracefully on the GOODBYE fan-out
+        w.thread.join(timeout=20)
+        assert not w.thread.is_alive() and w.error is None
+
+        cfg2, trainer2 = _mk_trainer(tmp_path, checkpoint_every=100)
+        assert trainer2.resume()
+        assert trainer2.global_iter == trainer.global_iter
+    finally:
+        clear_handler()
+        pool.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# slow: REAL worker subprocesses — SIGKILL chaos + learner SIGTERM
+# ---------------------------------------------------------------------------
+
+_SUB_ENV_SETUP = r"""
+import os, sys
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+try:
+    import jax._src.xla_bridge as xb
+    xb._clear_backends()
+except Exception:
+    pass
+"""
+
+_POOL_WORKER = _SUB_ENV_SETUP + r"""
+import signal
+import numpy as np
+from orion_tpu.config import ModelConfig, RolloutConfig
+from orion_tpu.models import Transformer
+from orion_tpu.orchestration.remote import PoolWorkerClient
+from orion_tpu.resilience import InjectedFault
+from orion_tpu.rollout.engine import RolloutEngine
+
+port, rank = int(sys.argv[1]), int(sys.argv[2])
+VOCAB, K, P, LUCKY = 32, 2, 4, 7
+mcfg = ModelConfig.tiny(vocab_size=VOCAB, hidden_size=32,
+                        intermediate_size=64, num_layers=2, num_heads=2,
+                        num_kv_heads=2, dtype="float32")
+eng = RolloutEngine(Transformer(mcfg), mcfg,
+                    RolloutConfig(max_new_tokens=8, temperature=1.0),
+                    eos_token_id=None, pad_token_id=0)
+client = PoolWorkerClient(port, name=f"sub-{rank}",
+                          heartbeat_interval=0.2, seed=rank,
+                          connect_timeout=60)
+rs = np.random.RandomState(100 + rank)
+
+def gen(i, version, params_host):
+    ids = np.repeat(rs.randint(1, VOCAB, (2, P)).astype(np.int32), K, 0)
+    lens = np.full(2 * K, P, np.int32)
+    host = eng.generate(ids, lens,
+                        jax.random.fold_in(jax.random.key(7 + rank), i),
+                        params=jax.device_put(params_host)).to_host()
+    comp = np.asarray(host.completions)
+    mask = np.asarray(host.completion_mask)
+    scores = (((comp == LUCKY) * mask).sum(1)
+              / np.maximum(mask.sum(1), 1)).astype(np.float32)
+    return {"result": host._fields(), "scores": scores}
+
+try:
+    sent = client.run(gen, None)
+except InjectedFault:
+    # The chaos plan fired on our trajectory send: die exactly like a
+    # preempted-without-grace host — SIGKILL, no goodbye, torn socket.
+    os.kill(os.getpid(), signal.SIGKILL)
+print(f"WORKER {rank} sent={sent}", flush=True)
+"""
+
+
+def _sub_env():
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))) + os.pathsep + env.get("PYTHONPATH", "")
+    env.pop("ORION_FAULT_PLAN", None)
+    return env
+
+
+@pytest.mark.slow
+def test_pool_chaos_sigkill_subprocess(tmp_path):
+    """The acceptance scenario with REAL processes: learner + 2 rollout
+    subprocesses, one SIGKILLed mid-run by its seeded FaultPlan — the
+    run completes on the survivor and the death lands in the metrics
+    recovery counters."""
+    cfg, trainer = _mk_trainer(tmp_path, checkpoint_every=100)
+    pool = WorkerPool(0, heartbeat_timeout=60.0)
+    procs = []
+    try:
+        orch = PoolOrchestrator(trainer, pool)
+        for rank in range(2):
+            env = _sub_env()
+            if rank == 0:  # this worker's 3rd trajectory send is fatal
+                env["ORION_FAULT_PLAN"] = "worker.traj:at=3"
+            procs.append(subprocess.Popen(
+                [sys.executable, "-c", _POOL_WORKER, str(pool.port),
+                 str(rank)],
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                env=env, text=True))
+        pool.wait_for_workers(2, timeout=300)
+        history = orch.train(prompt_stream(2, P), num_iterations=6)
+        assert len(history) == 6 and trainer.global_iter == 6
+        assert pool.recovery["worker_deaths"] == 1
+        assert history[-1]["worker_deaths"] == 1.0
+        assert all(np.isfinite(h["loss"]) for h in history)
+        # rank 0 really died by SIGKILL; rank 1 survived to GOODBYE
+        pool.shutdown(goodbye=True)
+        out0, _ = procs[0].communicate(timeout=60)
+        out1, _ = procs[1].communicate(timeout=120)
+        assert procs[0].returncode == -signal.SIGKILL, out0[-2000:]
+        assert procs[1].returncode == 0, out1[-2000:]
+        assert "WORKER 1 sent=" in out1
+    finally:
+        pool.shutdown()
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.communicate(timeout=30)
+
+
+_SIGTERM_LEARNER = _SUB_ENV_SETUP.replace(
+    "device_count=2", "device_count=8") + r"""
+import threading, time
+import numpy as np
+from orion_tpu.config import (GRPOConfig, ModelConfig, OptimizerConfig,
+                              ResilienceConfig, RolloutConfig)
+from orion_tpu.models import Transformer, init_params
+from orion_tpu.orchestration import (PoolOrchestrator, PoolWorkerClient,
+                                     WorkerPool)
+from orion_tpu.resilience import install_handler
+from orion_tpu.trainers import GRPOTrainer
+
+ckpt_dir = sys.argv[1]
+handler = install_handler()  # real SIGTERM → graceful shutdown
+VOCAB, K, P, T = 32, 2, 4, 8
+mcfg = ModelConfig.tiny(vocab_size=VOCAB, hidden_size=32,
+                        intermediate_size=64, num_layers=2, num_heads=2,
+                        num_kv_heads=2, dtype="float32")
+cfg = GRPOConfig(model=mcfg, group_size=K, kl_coef=0.0, num_epochs=1,
+                 optimizer=OptimizerConfig(learning_rate=5e-3,
+                                           grad_clip=1.0),
+                 rollout=RolloutConfig(max_new_tokens=T, temperature=1.0),
+                 rollout_batch_size=2 * K, minibatch_size=2 * K,
+                 log_every=0, async_mode=True, async_staleness=1,
+                 checkpoint_dir=ckpt_dir, checkpoint_every=100,
+                 resilience=ResilienceConfig())
+model = Transformer(mcfg)
+trainer = GRPOTrainer(cfg, model,
+                      init_params(model, jax.random.key(0), mcfg),
+                      reward_fn=None, eos_token_id=None)
+pool = WorkerPool(0, heartbeat_timeout=60.0)
+orch = PoolOrchestrator(trainer, pool)
+
+def fake_payload(rng):
+    B = 2 * K
+    seq = rng.randint(1, VOCAB, (B, P + T)).astype(np.int32)
+    mask = np.ones((B, T), np.float32)
+    lp = -np.abs(rng.randn(B, T)).astype(np.float32)
+    return {"result": dict(
+        sequences=seq, completions=seq[:, P:].copy(),
+        completion_mask=mask, completion_lens=np.full(B, T, np.int32),
+        logprobs=lp, policy_logprobs=lp.copy(),
+        prompt_lens=np.full(B, P, np.int32),
+        total_lens=np.full(B, P + T, np.int32)),
+        "scores": np.arange(B, dtype=np.float32)}
+
+def worker():
+    client = PoolWorkerClient(pool.port, name="w0",
+                              heartbeat_interval=0.1, seed=0)
+    rng = np.random.RandomState(5)
+    try:
+        client.run(lambda i, v, p: fake_payload(rng), None)
+    except Exception:
+        pass
+
+threading.Thread(target=worker, daemon=True).start()
+
+def progress():
+    while trainer.global_iter < 2:
+        time.sleep(0.05)
+    print("READY", flush=True)
+
+threading.Thread(target=progress, daemon=True).start()
+history = orch.train(None, num_iterations=10000)
+events = [k for k, _ in orch.events]
+print(f"STOPPED iter={trainer.global_iter} events={events}", flush=True)
+sys.exit(0)
+"""
+
+
+@pytest.mark.slow
+def test_sigterm_learner_checkpoints_and_exits_zero(tmp_path):
+    """A REAL SIGTERM to a real learner process: it finishes the
+    in-flight step, saves a checkpoint, GOODBYEs its worker, and exits
+    0 — and the checkpoint restores in a fresh session."""
+    ckpt_dir = str(tmp_path / "ckpt")
+    p = subprocess.Popen(
+        [sys.executable, "-c", _SIGTERM_LEARNER, ckpt_dir],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        env=_sub_env(), text=True, bufsize=1)
+    lines = []
+    try:
+        deadline = time.monotonic() + 300
+        while True:
+            if time.monotonic() > deadline:
+                p.kill()
+                pytest.fail("learner never reached iteration 2:\n"
+                            + "".join(lines[-50:]))
+            line = p.stdout.readline()
+            lines.append(line)
+            if "READY" in line:
+                break
+            if line == "" and p.poll() is not None:
+                pytest.fail("learner died early:\n" + "".join(lines))
+        p.send_signal(signal.SIGTERM)
+        out, _ = p.communicate(timeout=180)
+        lines.append(out)
+    finally:
+        if p.poll() is None:
+            p.kill()
+            p.communicate(timeout=30)
+    full = "".join(lines)
+    assert p.returncode == 0, full[-3000:]
+    assert "STOPPED" in full and "preempt" in full, full[-3000:]
+
+    # the checkpoint a preempted learner leaves behind must restore
+    cfg, trainer2 = _mk_trainer(tmp_path, checkpoint_every=100)
+    assert trainer2.resume()
+    assert trainer2.global_iter >= 2
