@@ -269,6 +269,38 @@ class RolloutConfig:
     # across the k clones' block tables — prefill FLOPs and prompt-page
     # HBM drop ~k×.  False = admit k independent clones (A/B baseline).
     group_prefix_sharing: bool = True
+    # -- serving-grade continuous engine (PR 8) ------------------------
+    # Cross-request prefix caching: hash-matched FULL prompt pages are
+    # shared read-only across requests (refcounted, LRU-evicted at
+    # refs==0) and a retiring request's prompt pages graduate into the
+    # cache instead of freeing — repeated prompts/prefixes skip their
+    # prefill.  The cache is invalidated whenever new weights land
+    # (cached KV is weight-dependent).  Disabled automatically when
+    # repetition_penalty != 1.0 (the seen-set would need the full
+    # prompt the skipped prefill never sees).
+    prefix_cache: bool = True
+    # Chunked prefill: admission prefill runs at most this many tokens
+    # per wave, so a long prompt is spread across decode segments
+    # instead of stalling every in-flight slot for one full-width
+    # prefill.  0 = one-shot prefill (the pre-PR8 behavior).
+    chunked_prefill_tokens: int = 0
+    # Admission order for the continuous scheduler: "fifo" (arrival
+    # order), "priority" (higher RequestSpec.priority first), or
+    # "deadline" (earliest deadline first).  No overtaking within the
+    # chosen order — the head request that does not fit blocks
+    # admission, which keeps every policy starvation-free.
+    admission_policy: str = "fifo"
+    # Pages held back from admission as growth headroom for in-flight
+    # sequences (on-demand allocation acquires pages mid-flight; the
+    # watermark makes preemption rare instead of structural).
+    # -1 = auto: one page per engine slot.
+    page_watermark: int = -1
+    # Waves between a slot's done-flag snapshot and its harvest.
+    # 1 lets the flag fetch ride out the next segment's execution —
+    # worth a full tunnel RTT per wave on a remote TPU link, but pure
+    # waste (one extra masked segment per request) on a local backend
+    # where the fetch is ~free.  -1 = auto: 1 on TPU, 0 elsewhere.
+    harvest_lag: int = -1
 
     def effective_min_new(self, eos_id) -> int:
         """min_new_tokens is only meaningful when SOME terminator can
@@ -324,6 +356,22 @@ class RolloutConfig:
             raise ValueError(
                 f"min_new_tokens={self.min_new_tokens} outside "
                 f"[0, max_new_tokens={self.max_new_tokens}]")
+        if self.admission_policy not in ("fifo", "priority", "deadline"):
+            raise ValueError(
+                f"admission_policy must be fifo|priority|deadline, got "
+                f"{self.admission_policy!r}")
+        if self.chunked_prefill_tokens < 0:
+            raise ValueError(
+                f"chunked_prefill_tokens must be >= 0 (0 disables), got "
+                f"{self.chunked_prefill_tokens}")
+        if self.page_watermark < -1:
+            raise ValueError(
+                f"page_watermark must be >= -1 (-1 = auto), got "
+                f"{self.page_watermark}")
+        if self.harvest_lag not in (-1, 0, 1):
+            raise ValueError(
+                f"harvest_lag must be -1 (auto), 0 or 1, got "
+                f"{self.harvest_lag}")
 
 
 @dataclass

@@ -85,10 +85,57 @@ def _decode_kernel(bt_ref, len_ref, q_ref, *refs, scale: float,
                              jnp.maximum(l_sc[:, :], 1e-30)).astype(o_ref.dtype)
 
 
+def paged_decode_attention_reference(q, k_pages, v_pages, block_tables,
+                                     seq_lens, scale: float,
+                                     k_scales=None, v_scales=None):
+    """Pure-XLA twin of the decode kernel (gather + masked softmax).
+
+    Same math as :func:`paged_decode_attention` — f32 accumulation,
+    GQA head h reads kv-head h // n_rep, int8 K scales land on the
+    scores and V scales on the probs with the normalizer taken BEFORE
+    the V scale (matching the kernel's online-softmax order).  This is
+    the execution path on interpret-mode platforms: the emulated Pallas
+    kernel is ~7x slower than XLA on CPU, which made the CPU serving
+    harness decode-bound on emulation overhead rather than on anything
+    the benchmark was measuring.
+    """
+    B, H, D = q.shape
+    _, Hkv, ps, _ = k_pages.shape
+    mp = block_tables.shape[1]
+    n_rep = H // Hkv
+
+    def gather(pages):                      # [N, Hkv, ps, D] -> slot order
+        g = jnp.take(pages, block_tables, axis=0)   # [B, mp, Hkv, ps, D]
+        return (g.transpose(0, 2, 1, 3, 4)
+                .reshape(B, Hkv, mp * ps, D).astype(jnp.float32))
+
+    def gather_s(scales):                   # [N, Hkv, 1, ps] -> [B,Hkv,S]
+        g = jnp.take(scales[:, :, 0, :], block_tables, axis=0)
+        return g.transpose(0, 2, 1, 3).reshape(B, Hkv, mp * ps)
+
+    k = gather(k_pages)
+    v = gather(v_pages)
+    qh = q.reshape(B, Hkv, n_rep, D).astype(jnp.float32) * scale
+    s = jnp.einsum("bkrd,bksd->bkrs", qh, k)
+    if k_scales is not None:
+        s = s * gather_s(k_scales)[:, :, None, :]
+    idx = jnp.arange(mp * ps, dtype=seq_lens.dtype)
+    s = jnp.where(idx[None, None, None, :] < seq_lens[:, None, None, None],
+                  s, _NEG_INF)
+    m = jnp.max(s, axis=-1, keepdims=True)
+    p = jnp.exp(s - m)
+    denom = jnp.maximum(jnp.sum(p, axis=-1, keepdims=True), 1e-30)
+    if v_scales is not None:
+        p = p * gather_s(v_scales)[:, :, None, :]
+    out = jnp.einsum("bkrs,bksd->bkrd", p, v) / denom
+    return out.reshape(B, H, D).astype(q.dtype)
+
+
 def paged_decode_attention(q: jnp.ndarray, k_pages: jnp.ndarray,
                            v_pages: jnp.ndarray, block_tables: jnp.ndarray,
                            seq_lens: jnp.ndarray, scale: float,
-                           k_scales=None, v_scales=None) -> jnp.ndarray:
+                           k_scales=None, v_scales=None,
+                           force_kernel: bool = False) -> jnp.ndarray:
     """One decode step of attention over a paged KV pool.
 
     q: [B, H, D] (current token per sequence);
@@ -100,7 +147,17 @@ def paged_decode_attention(q: jnp.ndarray, k_pages: jnp.ndarray,
       tokens [j*page_size, (j+1)*page_size) of that sequence;
     seq_lens: [B] int32 — number of valid tokens (inclusive of the
       current one).  Returns [B, H, D] in q.dtype.
+
+    Off-TPU this dispatches to the pure-XLA reference twin instead of
+    the emulated kernel (same math, ~7x faster on CPU — the difference
+    between the CPU serving harness measuring the engine and measuring
+    Pallas emulation).  ``force_kernel=True`` pins the (interpreted)
+    kernel — the kernel-logic tests use it.
     """
+    if _interpret() and not force_kernel:
+        return paged_decode_attention_reference(
+            q, k_pages, v_pages, block_tables, seq_lens, scale,
+            k_scales=k_scales, v_scales=v_scales)
     B, H, D = q.shape
     _, Hkv, page_size, _ = k_pages.shape
     max_pages = block_tables.shape[1]
@@ -154,12 +211,14 @@ def paged_decode_attention(q: jnp.ndarray, k_pages: jnp.ndarray,
 
 
 def paged_decode_attention_int8(q, k_pages, v_pages, k_scales, v_scales,
-                                block_tables, seq_lens, scale: float):
+                                block_tables, seq_lens, scale: float,
+                                force_kernel: bool = False):
     """int8-pool entry point (scales REQUIRED); thin delegation to
     :func:`paged_decode_attention`."""
     return paged_decode_attention(q, k_pages, v_pages, block_tables,
                                   seq_lens, scale, k_scales=k_scales,
-                                  v_scales=v_scales)
+                                  v_scales=v_scales,
+                                  force_kernel=force_kernel)
 
 
 def paged_decode_attention_sharded(q, k_pages, v_pages, block_tables,

@@ -13,16 +13,39 @@ reshuffling.  The per-segment jitted program is the same model decode
 step the simple engine uses (paged Pallas attention), batched over all
 slots; empty slots ride along masked.
 
-Flow per wave:
-  admit() -> prefill each admitted request (jitted, fixed prompt bucket)
-  -> decode segment of K tokens (jitted) -> harvest finished slots,
-  free their pages, loop.
+PR 8 turned this into a standing generation SERVICE:
+
+- ``submit()`` / ``step()`` are the request-level surface — requests
+  arrive over time (with optional priority / deadline), each ``step``
+  runs one wave, and completions stream back as they finish.
+  ``generate()`` remains the run-to-completion wrapper.
+- Pages are allocated ON DEMAND and recycled mid-flight: admission
+  grants pages for the prompt + first token only, each wave extends
+  in-flight sequences by one segment's worth against the scheduler's
+  watermark, and a harvested request's pages free at that segment
+  boundary.  When the pool still runs dry the engine preempts the
+  youngest decoding request (restart-by-recompute, vLLM style).
+- Cross-request prefix caching: full prompt pages are chain-hashed;
+  hash-matched prefixes share the retired requests' pages read-only
+  (refcounted in the scheduler) and skip their prefill — the k-clone
+  shared-prompt machinery generalized to arbitrary common prefixes.
+  The cache is dropped whenever new weights land.
+- Chunked prefill: ``chunked_prefill_tokens`` bounds how much prompt a
+  single wave forwards, so admitting a long prompt interleaves with
+  decode segments instead of stalling every in-flight slot.
+
+Flow per wave (one ``step()``):
+  admit -> chunk-prefill admitted/partial prompts (final chunks sample
+  their first token) -> extend in-flight reservations (preempting if
+  dry) -> decode segment of K tokens (jitted) -> harvest finished
+  slots (one wave lagged), free their pages, return completions.
 """
 
 from __future__ import annotations
 
 import contextlib
 import dataclasses
+import hashlib
 from functools import partial
 from typing import Iterable, List, Optional, Tuple
 
@@ -35,6 +58,11 @@ from orion_tpu.config import ModelConfig, RolloutConfig
 from orion_tpu.ops.sampling import (eos_forbid_mask, is_stop_token,
                                     sample_tokens, seen_from_prompts)
 from orion_tpu.runtime import Scheduler
+
+# slot lifecycle: empty -> prefilling (admitted, prompt KV being
+# written chunk by chunk) -> decoding (first token sampled, segments
+# advance it) -> empty (harvested or preempted).
+_EMPTY, _PREFILL, _DECODE = 0, 1, 2
 
 
 @dataclasses.dataclass
@@ -71,6 +99,22 @@ class ContinuousBatchingEngine:
         self.pad = pad_token_id
         self.segment_len = (cfg.segment_len if segment_len is None
                             else segment_len)
+        # Prefix caching needs the skipped prefix to be history-free
+        # for sampling state; the repetition-penalty seen-set is built
+        # from the full prompt the cached path never forwards.  Same
+        # for chunked prefill.  Degrade loudly, never silently.
+        self._prefix_cache_on = (cfg.prefix_cache
+                                 and cfg.repetition_penalty == 1.0)
+        self._chunk = (cfg.chunked_prefill_tokens
+                       if cfg.repetition_penalty == 1.0 else 0)
+        if cfg.repetition_penalty != 1.0 and (
+                cfg.prefix_cache or cfg.chunked_prefill_tokens):
+            import warnings
+
+            warnings.warn(
+                "continuous engine: repetition_penalty != 1.0 disables "
+                "prefix_cache and chunked_prefill_tokens (the penalty's "
+                "seen-set needs the full prompt forward)", stacklevel=2)
         # Sharded engine (VERDICT r3 missing #2): with a mesh, the
         # decode twin's params shard via the standard tensor rules, the
         # paged pools shard over kv-heads on the tensor axis, and the
@@ -96,7 +140,10 @@ class ContinuousBatchingEngine:
         self.pages_per_seq = -(-(cfg.max_prompt_len + cfg.max_new_tokens)
                                // ps)
         self.num_pages = cfg.num_pages or self.slots * self.pages_per_seq
-        self.sched = Scheduler(self.num_pages, ps, self.slots)
+        wm = (cfg.page_watermark if cfg.page_watermark >= 0
+              else self.slots)
+        self.sched = Scheduler(self.num_pages, ps, self.slots,
+                               watermark=wm, policy=cfg.admission_policy)
 
         # One extra scratch page (index num_pages): inactive/done slots
         # point their whole block table at it, so their masked lockstep
@@ -155,11 +202,39 @@ class ContinuousBatchingEngine:
             self._param_shardings = None
         self._bt = np.full((self.slots, self.pages_per_seq), self._scratch,
                            np.int32)
+        self._bt_dev = None     # device copy of _bt, rebuilt when dirty
         self._params = None
 
+        # -- service state (submit/step) --------------------------------
+        self._state = None                      # device per-slot state
+        self._slot_req = np.full(self.slots, -1, np.int64)
+        self._slot_seq = np.full(self.slots, -1, np.int64)
+        self._phase = np.zeros(self.slots, np.int8)
+        self._est_len = np.zeros(self.slots, np.int64)  # host len bound
+        self._reqinfo: dict = {}    # member id -> (ids, budget, head, j, k)
+        self._prefilling: dict = {}  # head id -> {"off": next position}
+        self._admit_seq: dict = {}   # member id -> admission counter
+        self._admit_counter = 0
+        self._pending_flags = None   # lagged (done, n_new, slot_seq) snap
+        self._early_out: List[CompletedRequest] = []  # pressure-harvested
+        self._rng = None
+        self.preemptions = 0         # recompute-restarts (metrics)
+        self.prefix_cached_pages = 0  # prompt pages served from cache
+        if cfg.harvest_lag >= 0:
+            self._harvest_lag = cfg.harvest_lag
+        else:
+            # Auto: the lag buys back a tunnel RTT per wave on a
+            # remote TPU link; on a local backend it only burns one
+            # masked segment per finished request.
+            from orion_tpu.ops.pallas import target_platform
+
+            with self._ctx():
+                self._harvest_lag = 1 if target_platform() == "tpu" else 0
+
         self._jit_prefill = jax.jit(self._prefill_fn,
-                                    donate_argnums=(1, 9),
+                                    donate_argnums=(1, 10),
                                     static_argnames=("do_copy",))
+        self._jit_chunk = jax.jit(self._chunk_fn, donate_argnums=(1,))
         self._jit_segment = jax.jit(self._segment_fn,
                                     donate_argnums=(1, 3),
                                     static_argnames=("n_steps",))
@@ -208,7 +283,8 @@ class ContinuousBatchingEngine:
         Identity-cached: the async rollout worker passes the SAME
         weight snapshot for every batch until a new version lands, and
         re-running the cast+quantize pass (a full read of the weights)
-        per batch bought nothing."""
+        per batch bought nothing.  A cache MISS means new weights: the
+        prefix cache (KV computed under the old weights) is dropped."""
         if params is getattr(self, "_prep_src", None):
             return self._prep_out
         if not hasattr(self, "_jit_prep"):
@@ -232,6 +308,8 @@ class ContinuousBatchingEngine:
             out = self._jit_prep(params)
         self._prep_src = params
         self._prep_out = out
+        # Cached prefix KV is weight-dependent: new weights, new cache.
+        self.sched.clear_cache()
         return out
 
     def load_weights(self, params) -> None:
@@ -250,6 +328,24 @@ class ContinuousBatchingEngine:
             b *= 2
         return min(b, cap)
 
+    def _page_hashes(self, ids: np.ndarray) -> Tuple[int, ...]:
+        """Chain hash per cacheable FULL prompt page: page i's hash
+        covers tokens [0, (i+1)*page_size), so equal hashes imply the
+        whole prefix (and its KV, which is causal) is bit-identical.
+        Capped at (plen-1)//page_size pages so a fully-cached prompt
+        still re-forwards >= 1 token for its first-sample logits."""
+        if not self._prefix_cache_on:
+            return ()
+        ps = self.cfg.page_size
+        n = max(0, (len(ids) - 1) // ps)
+        out, h = [], b""
+        for i in range(n):
+            h = hashlib.blake2b(
+                h + ids[i * ps:(i + 1) * ps].tobytes(),
+                digest_size=8).digest()
+            out.append(int.from_bytes(h, "little") & ((1 << 63) - 1))
+        return tuple(out)
+
     # -- jitted programs ------------------------------------------------
     def _cache(self, pools, bt):
         return [{**p, "block_tables": bt} for p in pools]
@@ -259,14 +355,40 @@ class ContinuousBatchingEngine:
         return [{k: v for k, v in c.items() if k != "block_tables"}
                 for c in cache]
 
+    def _chunk_fn(self, params, pools, bt_rows, chunk_ids, offs):
+        """One INTERMEDIATE prefill chunk: write prompt KV for C
+        consecutive positions per row (positions offs[b] ..
+        offs[b]+C-1, all real prompt tokens — rows whose remainder fits
+        in a chunk go through _prefill_fn instead), attending causally
+        to everything already in the pool.  No sampling, no state: only
+        the pools change.  Pad rows ride on all-scratch tables."""
+        from orion_tpu.models.transformer import maybe_unstack_for_decode
+
+        params = maybe_unstack_for_decode(params, self.mc)
+        B, C = chunk_ids.shape
+        positions = offs[:, None] + jnp.arange(C, dtype=jnp.int32)[None, :]
+        cache = self._cache(pools, bt_rows)
+        # Project logits at one position only — they are discarded, and
+        # [B, 1, V] keeps the (model-largest) vocab matmul out of the
+        # chunk's cost.
+        _, cache = self._decode_model.apply(
+            {"params": params}, chunk_ids, positions, cache,
+            logits_positions=jnp.zeros((B, 1), jnp.int32))
+        return self._strip(cache)
+
     def _prefill_fn(self, params, pools, bt_rows, prompt_ids, prompt_lens,
-                    slot_idx, budgets, copy_src, copy_dst, state, rng,
-                    do_copy: bool = True):
-        """One admission WAVE: fill pages for all admitted requests in a
-        single jitted program (the r1 per-request serial prefill was the
-        opposite of what continuous batching is for — VERDICT weak #5),
-        then scatter the first sampled token straight into the per-slot
+                    offs, slot_idx, budgets, copy_src, copy_dst, state,
+                    rng, do_copy: bool = True):
+        """FINAL admission chunk for a wave of requests: write the last
+        (or only) span of prompt KV in one jitted program, then scatter
+        each request's first sampled token straight into the per-slot
         DEVICE state — admission costs zero host fetches.
+
+        ``offs`` [B] is each row's chunk start: 0 for a one-shot
+        prefill, the chunk cursor for chunked prefill, cached_pages *
+        page_size when a prefix-cache hit skipped the shared prefix.
+        The attention mask is position-based over the gathered pool, so
+        history (cached pages + earlier chunks) is attended exactly.
 
         Group sampling (VERDICT r4 missing #3): each row may fan out to
         K clone slots sharing its prompt.  The prompt is prefilled ONCE
@@ -279,27 +401,27 @@ class ContinuousBatchingEngine:
         next to the k× prefill FLOPs saved).  Each clone then samples
         its OWN first token from the shared last-position logits.
 
-        prompt_ids [B, P] right-padded, P bucketed to the wave's max
-        prompt length (≤ max_prompt_len — short waves no longer pay a
-        full-width prefill, VERDICT r4 weak #3); bt_rows
-        [B, pages_per_seq] primary tables (pad rows wholly scratch);
-        slot_idx/budgets [B, K] int32 (pad entries slot = S, out of
-        bounds → their scatters drop); copy_src/copy_dst [B, K] page
-        indices (no-op entries point at the scratch page).
-        Returns (pools, state).
+        prompt_ids [B, P] holds tokens offs[b] .. offs[b]+P-1
+        right-padded, P bucketed to the wave's max REMAINING prompt
+        span (short waves no longer pay a full-width prefill, VERDICT
+        r4 weak #3); bt_rows [B, pages_per_seq] primary tables (pad
+        rows wholly scratch); slot_idx/budgets [B, K] int32 (pad
+        entries slot = S, out of bounds → their scatters drop);
+        copy_src/copy_dst [B, K] page indices (no-op entries point at
+        the scratch page).  Returns (pools, state).
         """
-        B, P = prompt_ids.shape
+        B, Pw = prompt_ids.shape
         K = slot_idx.shape[1]
         from orion_tpu.models.transformer import maybe_unstack_for_decode
 
         params = maybe_unstack_for_decode(params, self.mc)
-        positions = jnp.broadcast_to(jnp.arange(P, dtype=jnp.int32), (B, P))
+        positions = offs[:, None] + jnp.arange(Pw, dtype=jnp.int32)[None, :]
         cache = self._cache(pools, bt_rows)
         # Vocab projection only at the last real prompt token (its
         # logits predict completion[0]) — see RolloutEngine prefill.
         logits, cache = self._decode_model.apply(
             {"params": params}, prompt_ids, positions, cache,
-            logits_positions=(prompt_lens - 1)[:, None])
+            logits_positions=(prompt_lens - 1 - offs)[:, None])
         pools_w = self._strip(cache)
         if do_copy:
             # Partial-prompt-page replication AFTER the prompt KV is
@@ -323,7 +445,9 @@ class ContinuousBatchingEngine:
         min_new = self.cfg.effective_min_new(self.eos)
         kw = {}
         if pen:
-            # wave-level seen set from the admitted prompts
+            # wave-level seen set from the admitted prompts (offs are
+            # all zero here: the penalty disables chunking/caching, so
+            # the full prompt is present in this program)
             wave_seen = seen_from_prompts(prompt_ids, prompt_lens, V)
             seen_flat = jnp.broadcast_to(
                 wave_seen[:, None, :], (B, K, V)).reshape(BK, V)
@@ -422,6 +546,369 @@ class ContinuousBatchingEngine:
             0, n_steps, body, (pools, state, rng))
         return pools, state
 
+    # -- request-level service API --------------------------------------
+    def reset_rng(self, rng: jax.Array) -> None:
+        """Seed (or reseed) the service sampling stream.  ``generate``
+        does this per call; standing-service users do it once."""
+        self._rng = rng
+
+    def submit(self, req_id: int, ids, budget: Optional[int] = None,
+               k: int = 1, priority: int = 0,
+               deadline: Optional[int] = None) -> None:
+        """Enqueue a request (or a k-clone sampling group with ids
+        req_id .. req_id+k-1).  budget ≤ cfg.max_new_tokens caps the
+        completion; priority/deadline feed the scheduler's admission
+        policy (cfg.admission_policy).  Completions come back from
+        later ``step()`` calls in finish order."""
+        cfg = self.cfg
+        ids = np.asarray(ids, np.int32)
+        budget = int(cfg.max_new_tokens if budget is None else budget)
+        k = int(k)
+        if len(ids) < 1 or len(ids) > cfg.max_prompt_len:
+            raise ValueError(
+                f"prompt {req_id}: length {len(ids)} outside "
+                f"[1, max_prompt_len={cfg.max_prompt_len}]")
+        if not 1 <= budget <= cfg.max_new_tokens:
+            raise ValueError(
+                f"request {req_id}: budget {budget} outside "
+                f"[1, max_new_tokens={cfg.max_new_tokens}]")
+        if not 1 <= k <= self.slots:
+            raise ValueError(
+                f"request {req_id}: group of {k} clones can never "
+                f"be admitted (max_slots={self.slots})")
+        for j in range(k):
+            if req_id + j in self._reqinfo:
+                raise ValueError(f"request id {req_id + j} already "
+                                 "in flight")
+        dl = -1 if deadline is None else int(deadline)
+        hashes = self._page_hashes(ids)
+        if k > 1:
+            self.sched.add_group(req_id, len(ids), budget, k,
+                                 priority=priority, deadline=dl,
+                                 prefix_hashes=hashes)
+        else:
+            self.sched.add(req_id, len(ids), budget, priority=priority,
+                           deadline=dl, prefix_hashes=hashes)
+        for j in range(k):
+            self._reqinfo[req_id + j] = (ids, budget, req_id, j, k)
+
+    @property
+    def pending(self) -> int:
+        """Requests submitted but not yet returned by ``step``."""
+        return len(self._reqinfo)
+
+    def _preempt_req(self, rid: int) -> None:
+        """Recompute-preemption: drop the victim's pages/slot back to
+        the pool and requeue it (the scheduler keeps its arrival
+        position); its partial completion is discarded and it restarts
+        from the prompt when readmitted.  The victim's zombie slot
+        keeps lockstep-decoding into the scratch page until the slot is
+        re-seeded by a later admission — masked work, never a hazard."""
+        slot = self.sched.slot(rid)
+        self.sched.preempt(rid)
+        ids, budget, head, j, k = self._reqinfo[rid]
+        # A requeued group clone restarts as a SOLO request (its group
+        # mates keep their shared pages via the scheduler refcounts).
+        self._reqinfo[rid] = (ids, budget, rid, 0, 1)
+        self._slot_req[slot] = -1
+        self._slot_seq[slot] = -1
+        self._phase[slot] = _EMPTY
+        self._admit_seq.pop(rid, None)
+        self._bt[slot, :] = self._scratch
+        self._bt_dev = None
+        self.preemptions += 1
+
+    def _extend_running(self) -> None:
+        """Grow every decoding slot's reservation to cover the next
+        segment (on-demand allocation), preempting youngest-first when
+        the pool runs dry."""
+        seg = self.segment_len
+        for slot in range(self.slots):
+            if self._phase[slot] != _DECODE:
+                continue
+            rid = int(self._slot_req[slot])
+            ids, budget, _, _, _ = self._reqinfo[rid]
+            target = min(len(ids) + budget,
+                         int(self._est_len[slot]) + seg)
+            while True:
+                got = self.sched.extend(rid, target)
+                if got >= 0:
+                    break
+                victims = [r for r, s in self._admit_seq.items()
+                           if r != rid
+                           and self._phase[self.sched.slot(r)] == _DECODE]
+                if self._pending_flags is not None:
+                    # A lagged done-flag may be holding a finished
+                    # request's pages: harvest it NOW before preempting
+                    # live work (or discarding the finished request's
+                    # own completed output by self-preemption).
+                    drained = self._harvest_pending()
+                    if drained:
+                        self._early_out.extend(drained)
+                        continue
+                if victims:
+                    self._preempt_req(
+                        max(victims, key=lambda r: self._admit_seq[r]))
+                    continue
+                if self._prefilling:
+                    # The pool is held by mid-chunked-prefill
+                    # admissions (not preemptable mid-write without
+                    # group-state surgery): restart THIS request
+                    # instead of killing the standing service — it
+                    # requeues at its arrival position and recomputes
+                    # once the prefills land and pages free up.
+                    self._preempt_req(rid)
+                    got = None
+                    break
+                raise RuntimeError(
+                    f"page pool exhausted: {self.num_pages} pages "
+                    f"cannot cover request {rid} even after "
+                    "preempting all others — raise num_pages or "
+                    "lower max_batch_size")
+            if got is None:
+                continue
+            if got > 0:
+                pages = self.sched.pages(rid)
+                self._bt[slot, :len(pages)] = pages
+                self._bt_dev = None
+            self._est_len[slot] = target
+
+    def _activate(self, entries, rng) -> None:
+        """Run the FINAL prefill chunk for `entries` (head id ->
+        rows_info dict) and flip their slots to decoding."""
+        cfg = self.cfg
+        S = self.slots
+        ps = cfg.page_size
+        nb = self._bucket(len(entries), S)
+        kmax = self._bucket(max(e["k"] for e in entries.values()), S)
+        span = max(len(e["ids"]) - e["off"] for e in entries.values())
+        Pw = min(max(16, self._bucket(span, cfg.max_prompt_len)),
+                 cfg.max_prompt_len)
+        rows = np.full((nb, Pw), self.pad, np.int32)
+        lens_w = np.ones((nb,), np.int32)
+        offs_w = np.zeros((nb,), np.int32)
+        bt_w = np.full((nb, self.pages_per_seq), self._scratch, np.int32)
+        slot_w = np.full((nb, kmax), S, np.int32)  # pad: OOB
+        budget_w = np.full((nb, kmax), cfg.max_new_tokens, np.int32)
+        copy_src = np.full((nb, kmax), self._scratch, np.int32)
+        copy_dst = np.full((nb, kmax), self._scratch, np.int32)
+        for b, e in enumerate(entries.values()):
+            ids, k, off = e["ids"], e["k"], e["off"]
+            plen = len(ids)
+            shared = plen // ps if k > 1 else 0
+            for j in range(k):
+                rid, slot = e["slots"][j]
+                pages = self.sched.pages(rid)
+                self._bt[slot, : len(pages)] = pages
+                # Unreserved tail → scratch page: prefill writes KV
+                # for every padded position, and a short reservation
+                # would otherwise wrap pad-position writes onto its
+                # *last real page*, clobbering prompt KV (ADVICE r1).
+                self._bt[slot, len(pages):] = self._scratch
+                self._slot_req[slot] = rid
+                self._phase[slot] = _DECODE
+                self._est_len[slot] = plen
+                slot_w[b, j] = slot
+                budget_w[b, j] = e["budget"]
+                if j > 0 and plen % ps != 0:
+                    # The partial last prompt page is decode-appended,
+                    # so each secondary clone gets a private copy of
+                    # the primary's.
+                    copy_src[b, j] = bt_w[b, shared]
+                    copy_dst[b, j] = self._bt[slot, shared]
+                if j == 0:
+                    bt_w[b] = self._bt[slot]
+            rows[b, :plen - off] = ids[off:]
+            lens_w[b] = plen
+            offs_w[b] = off
+        self._bt_dev = None
+        has_groups = any(e["k"] > 1 for e in entries.values())
+        with self._ctx():
+            pools, state = self._jit_prefill(
+                self._params, self._pools, jnp.asarray(bt_w),
+                jnp.asarray(rows), jnp.asarray(lens_w),
+                jnp.asarray(offs_w), jnp.asarray(slot_w),
+                jnp.asarray(budget_w), jnp.asarray(copy_src),
+                jnp.asarray(copy_dst), self._state, rng,
+                do_copy=has_groups)
+        self._pools, self._state = pools, state
+
+    def _prefill_wave(self, rng) -> None:
+        """Advance every mid-prefill prompt by one chunk: rows whose
+        remainder exceeds the chunk budget run one INTERMEDIATE chunk
+        (KV only); the rest run their FINAL chunk (+ sampling) and
+        start decoding.  With chunking disabled every admission is a
+        final chunk — the pre-PR8 one-shot wave."""
+        chunk = self._chunk
+        inter, final = {}, {}
+        for head, e in self._prefilling.items():
+            remaining = len(e["ids"]) - e["off"]
+            if chunk > 0 and remaining > chunk:
+                inter[head] = e
+            else:
+                final[head] = e
+        if inter:
+            nb = self._bucket(len(inter), self.slots)
+            rows = np.full((nb, chunk), self.pad, np.int32)
+            offs = np.zeros((nb,), np.int32)
+            bt_w = np.full((nb, self.pages_per_seq), self._scratch,
+                           np.int32)
+            for b, (head, e) in enumerate(inter.items()):
+                off = e["off"]
+                rows[b] = e["ids"][off:off + chunk]
+                offs[b] = off
+                pages = self.sched.pages(head)
+                bt_w[b, :len(pages)] = pages
+                e["off"] = off + chunk
+            with self._ctx():
+                self._pools = self._jit_chunk(
+                    self._params, self._pools, jnp.asarray(bt_w),
+                    jnp.asarray(rows), jnp.asarray(offs))
+        if final:
+            self._activate(final, rng)
+        self._prefilling = {h: e for h, e in self._prefilling.items()
+                            if h not in final}
+
+    def step(self) -> List[CompletedRequest]:
+        """Run ONE wave of the standing service: harvest-lagged flag
+        processing, admission, one prefill chunk, reservation growth,
+        one decode segment.  Returns requests that completed."""
+        if self._params is None:
+            raise ValueError("no weights loaded: call load_weights() first")
+        if self._rng is None:
+            raise ValueError("no sampling stream: call reset_rng() first")
+        if self._state is None:
+            self._state = self._init_state()
+        self._early_out = []
+
+        # -- admission (between jitted segments) ------------------------
+        admitted = self.sched.admit()
+        if (not admitted and not self.sched.running
+                and not self._prefilling and self.sched.waiting):
+            raise RuntimeError(
+                f"{self.sched.waiting} request(s) can never be "
+                f"scheduled: pool of {self.num_pages} pages is too "
+                "small for a single request's admission")
+        for rid, slot in admitted:
+            ids, budget, head, j, k = self._reqinfo[rid]
+            self._slot_req[slot] = rid
+            self._slot_seq[slot] = self._admit_counter
+            self._phase[slot] = _PREFILL
+            self._admit_seq[rid] = self._admit_counter
+            self._admit_counter += 1
+            if j == 0:
+                cached = self.sched.cached_count(rid)
+                self.prefix_cached_pages += cached
+                e = self._prefilling.setdefault(
+                    head, {"ids": ids, "budget": budget, "k": k,
+                           "off": cached * self.cfg.page_size,
+                           "slots": {}})
+                e["slots"][j] = (rid, slot)
+            else:
+                self._prefilling[head]["slots"][j] = (rid, slot)
+
+        # -- prefill (one chunk per wave; final chunks sample) ----------
+        if self._prefilling:
+            self._rng, sub = jax.random.split(self._rng)
+            self._prefill_wave(sub)
+
+        # -- on-demand reservation growth (may preempt) -----------------
+        self._extend_running()
+
+        # -- decode segment (fixed length: done slots idle in place,
+        #    so no reservation-overrun risk) ----------------------------
+        if (self._phase == _DECODE).any():
+            self._rng, sub = jax.random.split(self._rng)
+            if self._bt_dev is None:
+                self._bt_dev = jnp.asarray(self._bt)
+            with self._ctx():
+                self._pools, self._state = self._jit_segment(
+                    self._params, self._pools, self._bt_dev, self._state,
+                    sub, n_steps=self.segment_len)
+            # snapshot this wave's flags (tiny copies — the state
+            # buffers themselves get donated to the next segment)
+            # PAIRED with the slot→ADMISSION-SEQ mapping at snapshot
+            # time: a done flag may only ever harvest the admission it
+            # was measured for.  The pairing keys on the engine-unique
+            # admission counter, NOT the request id — callers legally
+            # reuse ids across generate() calls, and an id-keyed guard
+            # let a stale snapshot from the previous occupant harvest
+            # a same-id successor one wave early (with the stale
+            # occupant's n_new reading past the successor's buffer).
+            # Only DECODE-phase slots are paired: a slot admitted but
+            # still mid-chunked-prefill carries the previous occupant's
+            # (or init) done flag, and its admission seq already
+            # matches — snapshotting it would false-harvest the
+            # activation one wave later with a stale n_new.
+            flags = (jnp.copy(self._state["done"]),
+                     jnp.copy(self._state["n_new"]),
+                     np.where(self._phase == _DECODE,
+                              self._slot_seq, -1))
+        else:
+            flags = None
+
+        # -- harvest: with harvest_lag=1 the flag fetch rides out the
+        #    NEXT segment's device execution instead of idling the chip
+        #    for a tunnel round-trip every wave (finished slots decode
+        #    at most one extra masked segment; their buffers are stable
+        #    once done).  With harvest_lag=0 (local backends) this
+        #    wave's flags are fetched immediately — the fetch is ~free
+        #    and the slot recycles a full segment earlier.  Pages free
+        #    HERE — the segment boundary where the finish is observed —
+        #    and are available to the very next admission.
+        if self._harvest_lag == 0:
+            self._pending_flags = flags
+            flags = None
+        out = self._early_out + self._harvest_pending()
+        self._early_out = []
+        self._pending_flags = flags
+        return out
+
+    def _harvest_pending(self) -> List[CompletedRequest]:
+        """Process the pending done-flag snapshot (if any): fetch the
+        finished slots' completion rows, retire them with the scheduler
+        (pages free here), and return the completions.  Clears the
+        pending snapshot."""
+        out: List[CompletedRequest] = []
+        if self._pending_flags is None:
+            return out
+        done_d, n_new_d, snap_seq = self._pending_flags
+        self._pending_flags = None
+        done_h, n_new_h = jax.device_get((done_d, n_new_d))
+        finished = [s for s in range(self.slots)
+                    if self._slot_req[s] >= 0
+                    and self._phase[s] == _DECODE
+                    and bool(done_h[s])
+                    and self._slot_seq[s] == snap_seq[s]]
+        if finished:
+            # One whole-buffer fetch: a gather program per
+            # finished-count compiles a fresh executable per count
+            # (profiled at ~0.3 s of in-loop compiles on the CPU
+            # serving trace), and the full [S, T] buffers are tiny
+            # (~50 KB at the 1B shape) next to any fetch's fixed
+            # cost.
+            rows_h = jax.device_get({
+                "t": self._state["toks"], "l": self._state["lps"],
+                "p": self._state["plps"]})
+            for s in finished:
+                rid = int(self._slot_req[s])
+                n = int(n_new_h[s])
+                out.append(CompletedRequest(
+                    req_id=rid,
+                    tokens=rows_h["t"][s][:n].astype(np.int32),
+                    logprobs=rows_h["l"][s][:n].astype(np.float32),
+                    policy_logprobs=rows_h["p"][s][:n].astype(
+                        np.float32)))
+                self.sched.finish(rid)
+                del self._reqinfo[rid]
+                self._admit_seq.pop(rid, None)
+                self._slot_req[s] = -1
+                self._slot_seq[s] = -1
+                self._phase[s] = _EMPTY
+                self._bt[s, :] = self._scratch  # free pages
+                self._bt_dev = None
+        return out
+
     # -- host driver ----------------------------------------------------
     def generate(self, requests: Iterable[Tuple[int, np.ndarray]],
                  rng: jax.Array, params=None) -> List[CompletedRequest]:
@@ -434,196 +921,51 @@ class ContinuousBatchingEngine:
         next admission instead of idling to the batch max) — or
         (req_id, prompt_ids, max_new_budget, k): a sampling GROUP of k
         clones with ids req_id .. req_id+k-1 drawing independent
-        completions from one shared prompt.  The prompt is prefilled
-        once and its fully-filled pages are physically shared across
-        the clones (GRPO/RLOO/Online-DPO sample k completions per
-        prompt; without sharing, prefill FLOPs and prompt-page HBM are
-        k× larger than necessary).  Caller must keep the implied id
-        ranges disjoint.
+        completions from one shared prompt.  Caller must keep the
+        implied id ranges disjoint.
+
+        This is the run-to-completion convenience wrapper over the
+        request-level service surface: ``submit`` every request, then
+        ``step`` until drained.
         """
-        params = (self._prep_params(params) if params is not None
-                  else self._params)
-        if params is None:
+        if params is not None:
+            self._params = self._prep_params(params)
+        if self._params is None:
             raise ValueError("no weights loaded: call load_weights() first")
-        cfg = self.cfg
-        S = self.slots
-        # Validate EVERY request before the first sched.add: the
-        # scheduler is long-lived engine state, so a mid-loop raise
-        # would leave earlier requests enqueued and poison every later
-        # generate() call (stale ids admitted with no prompt entry).
+        self.reset_rng(rng)
+        # Validate EVERY request before the first submit: the scheduler
+        # is long-lived engine state, so a mid-loop raise would leave
+        # earlier requests enqueued and poison every later generate()
+        # call (stale ids admitted with no prompt entry).
         reqs = []
+        seen = set(self._reqinfo)
         for r in requests:
-            req_id, ids = r[0], r[1]
+            req_id, ids = r[0], np.asarray(r[1], np.int32)
             budget = int(r[2]) if len(r) > 2 and r[2] is not None \
-                else cfg.max_new_tokens
+                else self.cfg.max_new_tokens
             k = int(r[3]) if len(r) > 3 else 1
-            if len(ids) > cfg.max_prompt_len:
+            for j in range(max(k, 1)):
+                if req_id + j in seen:
+                    raise ValueError(
+                        f"request id {req_id + j} already in flight")
+                seen.add(req_id + j)
+            if len(ids) > self.cfg.max_prompt_len:
                 raise ValueError(f"prompt {req_id} longer than "
-                                 f"max_prompt_len={cfg.max_prompt_len}")
-            if not 1 <= budget <= cfg.max_new_tokens:
+                                 f"max_prompt_len={self.cfg.max_prompt_len}")
+            if not 1 <= budget <= self.cfg.max_new_tokens:
                 raise ValueError(
                     f"request {req_id}: budget {budget} outside "
-                    f"[1, max_new_tokens={cfg.max_new_tokens}]")
-            if not 1 <= k <= S:
+                    f"[1, max_new_tokens={self.cfg.max_new_tokens}]")
+            if not 1 <= k <= self.slots:
                 raise ValueError(
                     f"request {req_id}: group of {k} clones can never "
-                    f"be admitted (max_slots={S})")
-            reqs.append((req_id, np.asarray(ids, np.int32), budget, k))
+                    f"be admitted (max_slots={self.slots})")
+            reqs.append((req_id, ids, budget, k))
         for req_id, ids, budget, k in reqs:
-            if k > 1:
-                self.sched.add_group(req_id, len(ids), budget, k)
-            else:
-                self.sched.add(req_id, len(ids), budget)
-        # member id -> (prompt, budget, head id, clone index, k)
-        prompts = {req_id + j: (ids, budget, req_id, j, k)
-                   for req_id, ids, budget, k in reqs for j in range(k)}
-
-        # host-side per-slot bookkeeping: ONLY the request mapping —
-        # cursors and completion buffers live on device (_init_state).
-        slot_req = np.full(S, -1, np.int64)
-        state = self._init_state()
-        pools = self._pools
+            self.submit(req_id, ids, budget=budget, k=k)
         out: List[CompletedRequest] = []
-        pending_flags = None  # (done, n_new) snapshot, harvested lagged
-
         while self.sched.waiting or self.sched.running:
-            # -- admission (between jitted segments) --------------------
-            admitted = self.sched.admit()
-            if not admitted and not self.sched.running:
-                raise RuntimeError(
-                    f"{self.sched.waiting} request(s) can never be "
-                    f"scheduled: pool of {self.num_pages} pages is too "
-                    "small for a single request's reservation")
-            if admitted:
-                # Batched admission prefill: ONE jitted call per wave.
-                # Wave size, clone fan-out, and prompt width are each
-                # padded to power-of-2 buckets, so the program count is
-                # bounded by log2(slots) × log2(slots) × log2(widths)
-                # — in practice a handful, since trainers use one k and
-                # similar prompt-length mixes.  The first sampled token
-                # lands in device state — zero host fetches here.
-                ps = cfg.page_size
-                # One row per unique prompt (group head or solo
-                # request); atomic group admission guarantees every
-                # clone of an admitted group is present in this wave.
-                rows_info: dict = {}
-                for rid, slot in admitted:
-                    ids, budget, head, j, k = prompts[rid]
-                    e = rows_info.setdefault(
-                        head, {"ids": ids, "budget": budget, "k": k,
-                               "slots": {}})
-                    e["slots"][j] = (rid, slot)
-                nb = self._bucket(len(rows_info), S)
-                kmax = self._bucket(
-                    max(e["k"] for e in rows_info.values()), S)
-                # Prompt width tracks the wave's longest prompt
-                # (VERDICT r4 weak #3): a 16-token prompt in a
-                # max_prompt_len=512 config no longer pays a 512-wide
-                # prefill.  Floor of 16 trims the trivial-width program
-                # count.
-                plen_max = max(len(e["ids"]) for e in rows_info.values())
-                P = min(max(16, self._bucket(plen_max, cfg.max_prompt_len)),
-                        cfg.max_prompt_len)
-                rows = np.full((nb, P), self.pad, np.int32)
-                lens_w = np.ones((nb,), np.int32)
-                bt_w = np.full((nb, self.pages_per_seq), self._scratch,
-                               np.int32)
-                slot_w = np.full((nb, kmax), S, np.int32)  # pad: OOB
-                budget_w = np.full((nb, kmax), cfg.max_new_tokens,
-                                   np.int32)
-                copy_src = np.full((nb, kmax), self._scratch, np.int32)
-                copy_dst = np.full((nb, kmax), self._scratch, np.int32)
-                for b, e in enumerate(rows_info.values()):
-                    ids, k = e["ids"], e["k"]
-                    plen = len(ids)
-                    shared = plen // ps if k > 1 else 0
-                    for j in range(k):
-                        rid, slot = e["slots"][j]
-                        pages = self.sched.pages(rid)
-                        self._bt[slot, : len(pages)] = pages
-                        # Unreserved tail → scratch page: prefill
-                        # writes KV for every padded prompt position,
-                        # and a short-reservation request (prompt_len +
-                        # max_new < max_prompt_len) would otherwise
-                        # wrap pad-position writes onto its *last real
-                        # page*, clobbering prompt KV (ADVICE r1 high).
-                        self._bt[slot, len(pages):] = self._scratch
-                        slot_req[slot] = rid
-                        slot_w[b, j] = slot
-                        budget_w[b, j] = e["budget"]
-                        if j > 0 and plen % ps != 0:
-                            # The partial last prompt page is decode-
-                            # appended, so each secondary clone gets a
-                            # private copy of the primary's.
-                            copy_src[b, j] = bt_w[b, shared]
-                            copy_dst[b, j] = self._bt[slot, shared]
-                        if j == 0:
-                            bt_w[b] = self._bt[slot]
-                    rows[b, :plen] = ids
-                    lens_w[b] = plen
-                rng, sub = jax.random.split(rng)
-                has_groups = any(e["k"] > 1
-                                 for e in rows_info.values())
-                with self._ctx():
-                    pools, state = self._jit_prefill(
-                        params, pools, jnp.asarray(bt_w), jnp.asarray(rows),
-                        jnp.asarray(lens_w), jnp.asarray(slot_w),
-                        jnp.asarray(budget_w), jnp.asarray(copy_src),
-                        jnp.asarray(copy_dst), state, sub,
-                        do_copy=has_groups)
-
-            # -- decode segment (fixed length: done slots idle in
-            #    place, so no reservation-overrun risk) ----------------
-            if (slot_req >= 0).any():
-                rng, sub = jax.random.split(rng)
-                with self._ctx():
-                    pools, state = self._jit_segment(
-                        params, pools, jnp.asarray(self._bt), state, sub,
-                        n_steps=self.segment_len)
-                # snapshot this wave's flags (tiny copies — the state
-                # buffers themselves get donated to the next segment)
-                # PAIRED with the slot→request mapping at snapshot time:
-                # a done flag may only ever harvest the request it was
-                # measured for (a slot re-admitted between snapshot and
-                # fetch would otherwise be harvested immediately with
-                # the previous occupant's n_new and buffer tail).
-                flags = (jnp.copy(state["done"]), jnp.copy(state["n_new"]),
-                         slot_req.copy())
-            else:
-                flags = None
-
-            # -- harvest ONE WAVE LATE: the flag fetch rides out the
-            #    next segment's device execution instead of idling the
-            #    chip for a tunnel round-trip every wave.  Finished
-            #    slots decode at most one extra (masked, dropped)
-            #    segment; their buffers are stable once done.
-            if pending_flags is not None:
-                done_d, n_new_d, snap_req = pending_flags
-                done_h, n_new_h = jax.device_get((done_d, n_new_d))
-                finished = [s for s in range(S)
-                            if slot_req[s] >= 0 and bool(done_h[s])
-                            and slot_req[s] == snap_req[s]]
-                if finished:
-                    fin = jnp.asarray(np.asarray(finished, np.int32))
-                    rows_h = jax.device_get({
-                        "t": jnp.take(state["toks"], fin, axis=0),
-                        "l": jnp.take(state["lps"], fin, axis=0),
-                        "p": jnp.take(state["plps"], fin, axis=0)})
-                    for j, s in enumerate(finished):
-                        n = int(n_new_h[s])
-                        out.append(CompletedRequest(
-                            req_id=int(slot_req[s]),
-                            tokens=rows_h["t"][j][:n].astype(np.int32),
-                            logprobs=rows_h["l"][j][:n].astype(
-                                np.float32),
-                            policy_logprobs=rows_h["p"][j][:n].astype(
-                                np.float32)))
-                        self.sched.finish(int(slot_req[s]))
-                        slot_req[s] = -1
-                        self._bt[s, :] = self._scratch  # free pages
-            pending_flags = flags
-
-        self._pools = pools
+            out.extend(self.step())
         return out
 
     # -- trainer-facing batch API (GenerationResult contract) -----------

@@ -9,45 +9,94 @@
 // Python-free so admission decisions cost O(1) C time in the decode
 // loop's host gap.
 //
-// Admission policy: conservative whole-lifetime reservation — a request
-// is admitted only when ceil((prompt_len + max_new) / page_size) pages
-// are free, so a running sequence can never run out of pages and no
-// preemption machinery is needed (matches the static-shape XLA regime).
+// Allocation policy (PR 8 — the serving-grade rework): ON-DEMAND pages
+// with mid-flight recycling, replacing the old conservative
+// whole-lifetime reservation that stranded ceil((plen+max_new)/ps)
+// pages per request for its entire life.  A request is admitted with
+// pages covering its prompt + first sampled token only; the engine
+// grows it segment-by-segment with Extend(), and a finished request's
+// pages return to the free list the moment it is harvested.  Admission
+// is gated by a WATERMARK of held-back pages so in-flight growth
+// rarely stalls; when the pool still runs dry, the engine preempts
+// (Preempt(): free + requeue for restart-by-recompute, the vLLM
+// recompute-preemption design).
+//
+// Cross-request prefix caching (SGLang-style radix reuse, re-expressed
+// at page granularity): the engine hands Add() a chain-hash per FULL
+// prompt page; admission shares the longest cached prefix (refcounted,
+// read-only), and Finish() inserts a retiring request's full prompt
+// pages into the cache instead of freeing them.  Unreferenced cached
+// pages form an LRU pool that allocation evicts before failing, so the
+// cache can never deadlock the allocator.  Copy-on-write at the
+// divergence page is structural: only bit-identical FULL pages are
+// ever shared, the first divergent page is freshly computed/private.
+//
+// Admission policies: FIFO (arrival order, no overtaking), PRIORITY
+// (higher value first, FIFO tiebreak), DEADLINE (EDF, FIFO tiebreak).
+// All decisions are deterministic and bit-identically mirrored by the
+// pure-Python PyScheduler (cross-checked in tests/test_runtime_native).
 //
 // C ABI (extern "C") for ctypes; handles are opaque pointers.
 
 #include <cstdint>
 #include <deque>
+#include <list>
 #include <unordered_map>
 #include <vector>
 
 namespace {
+
+constexpr int kPolicyFifo = 0;
+constexpr int kPolicyPriority = 1;
+constexpr int kPolicyDeadline = 2;
+constexpr int64_t kNoDeadline = -1;
 
 struct Request {
   int64_t id;
   int prompt_len;
   int max_new;
   int group_k = 1;        // waiting entries: clones in this group
+  int priority = 0;       // larger = admitted sooner (PRIORITY policy)
+  int64_t deadline = kNoDeadline;  // EDF key (DEADLINE policy)
+  int64_t seq = 0;        // arrival order; preserved across preemption
   int slot = -1;
-  int shared_count = 0;   // leading pages of `pages` owned by the group
+  int cached_count = 0;   // leading pages shared via the prefix cache
+  int shared_count = 0;   // pages after `cached` owned by the group
   int64_t group_id = -1;  // head request id, or -1 for a solo request
+  std::vector<int64_t> hashes;  // chain hash per full prompt page
   std::vector<int32_t> pages;
 };
 
 // Prompt pages shared by a sampling group (GRPO/RLOO/Online-DPO draw k
-// completions per prompt): the fully-filled prompt pages are written
-// once at prefill and are read-only afterwards, so all k clones' block
-// tables can point at one physical copy.  Freed when the last clone
-// finishes (refcount).
+// completions per prompt): written once at prefill, read-only after,
+// so all k clones' block tables point at one physical copy.  When the
+// last clone retires, pages with a known hash graduate into the prefix
+// cache instead of the free list.
 struct Group {
   std::vector<int32_t> pages;
+  std::vector<int64_t> hashes;  // hash per pages[i] (may be shorter)
   int refs;
+};
+
+// A page held by the prefix cache.  refs counts running readers; at
+// refs==0 the page parks in the LRU available list, reusable by new
+// matches or evictable by the allocator.  `orphan` marks a page whose
+// hash mapping was dropped by ClearCache() while readers were still
+// attached — it frees (never re-parks) on its last unref.
+struct CachedPage {
+  int64_t hash;
+  int refs = 0;
+  bool orphan = false;
 };
 
 class Scheduler {
  public:
-  Scheduler(int num_pages, int page_size, int max_slots)
-      : page_size_(page_size), max_slots_(max_slots) {
+  Scheduler(int num_pages, int page_size, int max_slots, int watermark,
+            int policy)
+      : page_size_(page_size),
+        max_slots_(max_slots),
+        watermark_(watermark),
+        policy_(policy) {
     free_pages_.reserve(num_pages);
     // LIFO free list: recently-freed (cache-warm) pages are reused first.
     for (int i = num_pages - 1; i >= 0; --i) free_pages_.push_back(i);
@@ -55,71 +104,70 @@ class Scheduler {
     for (int i = max_slots - 1; i >= 0; --i) free_slots_.push_back(i);
   }
 
-  void Add(int64_t id, int prompt_len, int max_new) {
-    Request r;
-    r.id = id;
-    r.prompt_len = prompt_len;
-    r.max_new = max_new;
-    waiting_.push_back(std::move(r));
+  int Add(int64_t id, int prompt_len, int max_new, int priority,
+          int64_t deadline, const int64_t* hashes, int n_hashes) {
+    return Enqueue(id, prompt_len, max_new, 1, priority, deadline, hashes,
+                   n_hashes, seq_counter_++);
   }
 
-  // Enqueue a shared-prefix sampling group: k clones with ids
-  // first_id .. first_id+k-1, all sampling from one prompt.  The
-  // group's fully-filled prompt pages (prompt_len / page_size) are
-  // allocated once; each clone additionally owns the pages covering
-  // the partial prompt tail + its completion.  Admission is atomic
-  // (all k clones or none) so the one-shot wave prefill can write the
-  // shared pages exactly once.  Returns 0, or -1 when k can never be
-  // admitted (k > max_slots would deadlock the FIFO queue).
-  int AddGroup(int64_t first_id, int prompt_len, int max_new, int k) {
+  int AddGroup(int64_t first_id, int prompt_len, int max_new, int k,
+               int priority, int64_t deadline, const int64_t* hashes,
+               int n_hashes) {
     if (k < 1 || k > max_slots_) return -1;
-    Request r;
-    r.id = first_id;
-    r.prompt_len = prompt_len;
-    r.max_new = max_new;
-    r.group_k = k;
-    waiting_.push_back(std::move(r));
-    return 0;
+    return Enqueue(first_id, prompt_len, max_new, k, priority, deadline,
+                   hashes, n_hashes, seq_counter_++);
   }
 
-  // Admit FIFO-order waiting requests while slots + pages suffice.
-  // Writes up to max_out (id, slot) pairs; returns the count.
+  // Admit waiting requests in policy order while slots + pages last.
+  // On-demand: an admitted request gets pages covering prompt_len + 1
+  // tokens only (full_prompt prefix-shareable pages + 1 private decode
+  // page per clone); the rest arrives via Extend().  The watermark
+  // holds pages back from admission — growth headroom for what is
+  // already running — except for the very first request into an empty
+  // scheduler, which may always use the whole pool.
   int Admit(int64_t* out_ids, int32_t* out_slots, int max_out) {
     int n = 0;
     while (!waiting_.empty() && !free_slots_.empty()) {
-      Request& head = waiting_.front();
+      std::size_t pick = SelectWaiting();
+      Request& head = waiting_[pick];
       int k = head.group_k;
-      int shared = k > 1 ? head.prompt_len / page_size_ : 0;
-      int total =
-          (head.prompt_len + head.max_new + page_size_ - 1) / page_size_;
-      int priv = total - shared;
-      // FIFO: no overtaking — stop at the first request that does not
-      // fit (groups are all-or-nothing so the shared pages are written
-      // by exactly one wave prefill).
+      int full_prompt = head.prompt_len / page_size_;
+      int cached = 0;
+      while (cached < static_cast<int>(head.hashes.size()) &&
+             cache_map_.count(head.hashes[cached]))
+        ++cached;
+      int shared_new = full_prompt - cached;
+      int need_new = shared_new + k;
+      int headroom = (!running_.empty() || n > 0) ? watermark_ : 0;
+      // Stop at the first request that does not fit: no overtaking
+      // within the policy order (starvation-free and deterministic).
       if (n + k > max_out) break;
       if (static_cast<int>(free_slots_.size()) < k) break;
-      if (static_cast<int>(free_pages_.size()) < shared + k * priv) break;
+      if (AvailablePages() < need_new + headroom) break;
       Request proto = std::move(head);
-      waiting_.pop_front();
-      std::vector<int32_t> shared_pages;
-      shared_pages.reserve(shared);
-      for (int i = 0; i < shared; ++i) {
-        shared_pages.push_back(free_pages_.back());
-        free_pages_.pop_back();
+      waiting_.erase(waiting_.begin() + pick);
+      std::vector<int32_t> cached_pages;
+      cached_pages.reserve(cached);
+      for (int i = 0; i < cached; ++i) {
+        int32_t p = cache_map_.at(proto.hashes[i]);
+        cached_pages.push_back(p);
+        RefCached(p, k);
       }
+      std::vector<int32_t> shared_pages;
+      shared_pages.reserve(shared_new);
+      for (int i = 0; i < shared_new; ++i) shared_pages.push_back(AllocPage());
       for (int j = 0; j < k; ++j) {
         Request r = proto;
         r.id = proto.id + j;
         r.slot = free_slots_.back();
         free_slots_.pop_back();
-        r.pages = shared_pages;
-        r.pages.reserve(total);
-        for (int i = 0; i < priv; ++i) {
-          r.pages.push_back(free_pages_.back());
-          free_pages_.pop_back();
-        }
+        r.pages = cached_pages;
+        r.pages.insert(r.pages.end(), shared_pages.begin(),
+                       shared_pages.end());
+        r.pages.push_back(AllocPage());
+        r.cached_count = cached;
         if (k > 1) {
-          r.shared_count = shared;
+          r.shared_count = shared_new;
           r.group_id = proto.id;
         }
         out_ids[n] = r.id;
@@ -127,9 +175,37 @@ class Scheduler {
         running_.emplace(r.id, std::move(r));
         ++n;
       }
-      if (k > 1) groups_.emplace(proto.id, Group{shared_pages, k});
+      if (k > 1) {
+        std::vector<int64_t> shared_hashes(
+            proto.hashes.begin() +
+                std::min<std::size_t>(cached, proto.hashes.size()),
+            proto.hashes.end());
+        groups_.emplace(proto.id,
+                        Group{shared_pages, std::move(shared_hashes), k});
+      }
     }
     return n;
+  }
+
+  // Grow a running request to hold `total_tokens` positions, appending
+  // freshly allocated pages to its table.  Returns the number of new
+  // pages (0 when already covered), -1 when the pool cannot supply
+  // them (the engine preempts and retries), -2 for an unknown id.
+  // Extend ignores the watermark: growth is exactly what the watermark
+  // reserve exists to serve.
+  int Extend(int64_t id, int total_tokens) {
+    auto it = running_.find(id);
+    if (it == running_.end()) return -2;
+    Request& r = it->second;
+    int cap = (r.prompt_len + r.max_new + page_size_ - 1) / page_size_;
+    int need = (total_tokens + page_size_ - 1) / page_size_;
+    if (need > cap) need = cap;
+    int cur = static_cast<int>(r.pages.size());
+    if (need <= cur) return 0;
+    int delta = need - cur;
+    if (AvailablePages() < delta) return -1;
+    for (int i = 0; i < delta; ++i) r.pages.push_back(AllocPage());
+    return delta;
   }
 
   // Copy the request's page table into out (capacity cap); returns the
@@ -148,73 +224,261 @@ class Scheduler {
     return it == running_.end() ? -1 : it->second.slot;
   }
 
-  // Leading pages of the request's table owned by its sampling group
-  // (0 for solo requests), or -1 if unknown id.
   int SharedCount(int64_t id) const {
     auto it = running_.find(id);
     return it == running_.end() ? -1 : it->second.shared_count;
   }
 
-  // Retire a finished request, freeing its slot and private pages
-  // (plus the group's shared pages when this was the last clone).
-  // Returns pages freed by THIS call, or -1 if unknown id.
+  int CachedCount(int64_t id) const {
+    auto it = running_.find(id);
+    return it == running_.end() ? -1 : it->second.cached_count;
+  }
+
+  // Retire a finished request: its slot frees, its private full prompt
+  // pages graduate into the prefix cache (dedup: an already-cached
+  // hash frees the duplicate page instead), everything else returns to
+  // the free list.  Returns pages pushed to the FREE list by this call
+  // (cache graduations are recycling too, but are reported via
+  // AvailablePages/CachedTotal), or -1 if unknown id.
   int Finish(int64_t id) {
     auto it = running_.find(id);
     if (it == running_.end()) return -1;
-    const Request& r = it->second;
-    int freed = static_cast<int>(r.pages.size()) - r.shared_count;
-    for (std::size_t i = r.shared_count; i < r.pages.size(); ++i)
+    Request r = std::move(it->second);
+    running_.erase(it);
+    int freed = 0;
+    for (int i = 0; i < r.cached_count; ++i) UnrefCached(r.pages[i]);
+    int priv_start = r.cached_count + r.shared_count;
+    for (std::size_t i = priv_start; i < r.pages.size(); ++i) {
+      int64_t h = (r.group_id < 0 && i < r.hashes.size()) ? r.hashes[i]
+                                                          : kNoDeadline;
+      freed += RetirePage(r.pages[i], r.group_id < 0 && i < r.hashes.size(),
+                          h);
+    }
+    free_slots_.push_back(r.slot);
+    if (r.group_id >= 0) {
+      auto git = groups_.find(r.group_id);
+      if (git != groups_.end() && --git->second.refs == 0) {
+        Group& g = git->second;
+        for (std::size_t i = 0; i < g.pages.size(); ++i) {
+          bool has_hash = i < g.hashes.size();
+          freed += RetirePage(g.pages[i], has_hash,
+                              has_hash ? g.hashes[i] : kNoDeadline);
+        }
+        groups_.erase(git);
+      }
+    }
+    return freed;
+  }
+
+  // Recompute-preemption support: free everything the request holds
+  // (no cache graduation — a preempted request's pages may be only
+  // partially prefilled) and requeue it, as a SOLO request, at its
+  // original arrival position.  The engine restarts it from the
+  // prompt.  Returns 0, or -1 if unknown id.
+  int Preempt(int64_t id) {
+    auto it = running_.find(id);
+    if (it == running_.end()) return -1;
+    Request r = std::move(it->second);
+    running_.erase(it);
+    for (int i = 0; i < r.cached_count; ++i) UnrefCached(r.pages[i]);
+    int priv_start = r.cached_count + r.shared_count;
+    for (std::size_t i = priv_start; i < r.pages.size(); ++i)
       free_pages_.push_back(r.pages[i]);
     free_slots_.push_back(r.slot);
     if (r.group_id >= 0) {
       auto git = groups_.find(r.group_id);
       if (git != groups_.end() && --git->second.refs == 0) {
-        freed += static_cast<int>(git->second.pages.size());
         for (int32_t p : git->second.pages) free_pages_.push_back(p);
         groups_.erase(git);
       }
     }
-    running_.erase(it);
-    return freed;
+    Request w;
+    w.id = r.id;
+    w.prompt_len = r.prompt_len;
+    w.max_new = r.max_new;
+    w.group_k = 1;
+    w.priority = r.priority;
+    w.deadline = r.deadline;
+    w.hashes = std::move(r.hashes);
+    w.seq = r.seq;
+    std::size_t pos = 0;
+    while (pos < waiting_.size() && waiting_[pos].seq < w.seq) ++pos;
+    waiting_.insert(waiting_.begin() + pos, std::move(w));
+    return 0;
+  }
+
+  // Drop the prefix cache (the engine calls this when new weights land
+  // — cached KV from old weights must never be matched again).
+  // Unreferenced pages return to the free list in LRU order; pages
+  // still referenced by running requests lose their hash mapping and
+  // free on their last unref.  Returns pages moved to the free list.
+  int ClearCache() {
+    int n = 0;
+    while (!avail_.empty()) {
+      int32_t p = avail_.front();
+      avail_.pop_front();
+      cache_map_.erase(cached_pages_.at(p).hash);
+      cached_pages_.erase(p);
+      free_pages_.push_back(p);
+      ++n;
+    }
+    for (auto& kv : cached_pages_) {
+      if (!kv.second.orphan) {
+        cache_map_.erase(kv.second.hash);
+        kv.second.orphan = true;
+      }
+    }
+    return n;
   }
 
   int FreePages() const { return static_cast<int>(free_pages_.size()); }
+  int AvailablePages() const {
+    return static_cast<int>(free_pages_.size() + avail_.size());
+  }
+  int CachedTotal() const { return static_cast<int>(cached_pages_.size()); }
   int Waiting() const { return static_cast<int>(waiting_.size()); }
   int Running() const { return static_cast<int>(running_.size()); }
 
  private:
+  int Enqueue(int64_t id, int prompt_len, int max_new, int k, int priority,
+              int64_t deadline, const int64_t* hashes, int n_hashes,
+              int64_t seq) {
+    Request r;
+    r.id = id;
+    r.prompt_len = prompt_len;
+    r.max_new = max_new;
+    r.group_k = k;
+    r.priority = priority;
+    r.deadline = deadline;
+    r.seq = seq;
+    // Engine-capped: at most (prompt_len - 1) / page_size hashes, so a
+    // fully-cached prompt still re-forwards >= 1 real token for its
+    // first-sample logits.  Clamp here so a buggy caller cannot make
+    // the scheduler share the page decode appends to.
+    int cap = prompt_len > 0 ? (prompt_len - 1) / page_size_ : 0;
+    if (n_hashes > cap) n_hashes = cap;
+    r.hashes.assign(hashes, hashes + n_hashes);
+    waiting_.push_back(std::move(r));
+    return 0;
+  }
+
+  std::size_t SelectWaiting() const {
+    if (policy_ == kPolicyFifo) return 0;
+    std::size_t best = 0;
+    for (std::size_t i = 1; i < waiting_.size(); ++i) {
+      const Request& a = waiting_[i];
+      const Request& b = waiting_[best];
+      bool better;
+      if (policy_ == kPolicyPriority) {
+        better = a.priority > b.priority ||
+                 (a.priority == b.priority && a.seq < b.seq);
+      } else {  // kPolicyDeadline: EDF, no-deadline sorts last
+        int64_t da = a.deadline == kNoDeadline ? INT64_MAX : a.deadline;
+        int64_t db = b.deadline == kNoDeadline ? INT64_MAX : b.deadline;
+        better = da < db || (da == db && a.seq < b.seq);
+      }
+      if (better) best = i;
+    }
+    return best;
+  }
+
+  // Pop a free page, evicting the LRU unreferenced cached page when
+  // the free list is empty.  Caller must have checked AvailablePages.
+  int32_t AllocPage() {
+    if (!free_pages_.empty()) {
+      int32_t p = free_pages_.back();
+      free_pages_.pop_back();
+      return p;
+    }
+    int32_t p = avail_.front();
+    avail_.pop_front();
+    cache_map_.erase(cached_pages_.at(p).hash);
+    cached_pages_.erase(p);
+    return p;
+  }
+
+  void RefCached(int32_t page, int count) {
+    CachedPage& c = cached_pages_.at(page);
+    if (c.refs == 0) {
+      for (auto it = avail_.begin(); it != avail_.end(); ++it) {
+        if (*it == page) {
+          avail_.erase(it);
+          break;
+        }
+      }
+    }
+    c.refs += count;
+  }
+
+  void UnrefCached(int32_t page) {
+    auto it = cached_pages_.find(page);
+    CachedPage& c = it->second;
+    if (--c.refs == 0) {
+      if (c.orphan) {
+        cached_pages_.erase(it);
+        free_pages_.push_back(page);
+      } else {
+        avail_.push_back(page);
+      }
+    }
+  }
+
+  // Retire one exclusively-owned page: graduate it into the prefix
+  // cache when it is a full prompt page with a known, not-yet-cached
+  // hash; otherwise push it to the free list.  Returns 1 when the page
+  // went to the free list.
+  int RetirePage(int32_t page, bool has_hash, int64_t hash) {
+    if (has_hash && !cache_map_.count(hash)) {
+      cache_map_.emplace(hash, page);
+      cached_pages_.emplace(page, CachedPage{hash, 0, false});
+      avail_.push_back(page);
+      return 0;
+    }
+    free_pages_.push_back(page);
+    return 1;
+  }
+
   int page_size_;
   int max_slots_;
+  int watermark_;
+  int policy_;
+  int64_t seq_counter_ = 0;
   std::vector<int32_t> free_pages_;
   std::vector<int32_t> free_slots_;
   std::deque<Request> waiting_;
   std::unordered_map<int64_t, Request> running_;
   std::unordered_map<int64_t, Group> groups_;
+  std::unordered_map<int64_t, int32_t> cache_map_;     // hash -> page
+  std::unordered_map<int32_t, CachedPage> cached_pages_;
+  std::list<int32_t> avail_;  // refs==0 cached pages, LRU front-first
 };
 
 }  // namespace
 
 extern "C" {
 
-void* osch_create(int num_pages, int page_size, int max_slots) {
-  if (num_pages <= 0 || page_size <= 0 || max_slots <= 0) return nullptr;
-  return new Scheduler(num_pages, page_size, max_slots);
+void* osch_create(int num_pages, int page_size, int max_slots, int watermark,
+                  int policy) {
+  if (num_pages <= 0 || page_size <= 0 || max_slots <= 0 || watermark < 0 ||
+      policy < kPolicyFifo || policy > kPolicyDeadline)
+    return nullptr;
+  return new Scheduler(num_pages, page_size, max_slots, watermark, policy);
 }
 
 void osch_destroy(void* h) { delete static_cast<Scheduler*>(h); }
 
-void osch_add(void* h, int64_t id, int prompt_len, int max_new) {
-  static_cast<Scheduler*>(h)->Add(id, prompt_len, max_new);
+int osch_add(void* h, int64_t id, int prompt_len, int max_new, int priority,
+             int64_t deadline, const int64_t* hashes, int n_hashes) {
+  return static_cast<Scheduler*>(h)->Add(id, prompt_len, max_new, priority,
+                                         deadline, hashes, n_hashes);
 }
 
 int osch_add_group(void* h, int64_t first_id, int prompt_len, int max_new,
-                   int k) {
+                   int k, int priority, int64_t deadline,
+                   const int64_t* hashes, int n_hashes) {
   return static_cast<Scheduler*>(h)->AddGroup(first_id, prompt_len, max_new,
-                                              k);
-}
-
-int osch_shared_count(void* h, int64_t id) {
-  return static_cast<Scheduler*>(h)->SharedCount(id);
+                                              k, priority, deadline, hashes,
+                                              n_hashes);
 }
 
 int osch_admit(void* h, int64_t* out_ids, int32_t* out_slots, int max_out) {
@@ -229,12 +493,40 @@ int osch_slot(void* h, int64_t id) {
   return static_cast<Scheduler*>(h)->Slot(id);
 }
 
+int osch_shared_count(void* h, int64_t id) {
+  return static_cast<Scheduler*>(h)->SharedCount(id);
+}
+
+int osch_cached_count(void* h, int64_t id) {
+  return static_cast<Scheduler*>(h)->CachedCount(id);
+}
+
+int osch_extend(void* h, int64_t id, int total_tokens) {
+  return static_cast<Scheduler*>(h)->Extend(id, total_tokens);
+}
+
+int osch_preempt(void* h, int64_t id) {
+  return static_cast<Scheduler*>(h)->Preempt(id);
+}
+
 int osch_finish(void* h, int64_t id) {
   return static_cast<Scheduler*>(h)->Finish(id);
 }
 
+int osch_clear_cache(void* h) {
+  return static_cast<Scheduler*>(h)->ClearCache();
+}
+
 int osch_free_pages(void* h) {
   return static_cast<Scheduler*>(h)->FreePages();
+}
+
+int osch_available_pages(void* h) {
+  return static_cast<Scheduler*>(h)->AvailablePages();
+}
+
+int osch_cached_total(void* h) {
+  return static_cast<Scheduler*>(h)->CachedTotal();
 }
 
 int osch_waiting(void* h) { return static_cast<Scheduler*>(h)->Waiting(); }

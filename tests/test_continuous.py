@@ -67,8 +67,9 @@ def test_continuous_eos_and_recycling():
         if 5 in r.tokens:
             hit_eos += 1
             assert r.tokens[-1] == 5  # trimmed at EOS
-    # All pages recycled at the end.
-    assert eng.sched.free_pages == eng.num_pages
+    # All pages recycled at the end: every page is either free or
+    # parked (unreferenced) in the prefix cache — nothing stranded.
+    assert eng.sched.available_pages == eng.num_pages
     assert eng.sched.running == 0 and eng.sched.waiting == 0
 
 
@@ -151,3 +152,253 @@ def test_continuous_int8_kv_pools():
         total += n
         assert np.isfinite(out_q[rid].logprobs).all()
     assert agree / total >= 0.8, f"int8-kv greedy agreement {agree/total}"
+
+
+# -- PR 8: serving-grade engine (chunked prefill, prefix cache,
+#    on-demand pages + preemption) -------------------------------------
+
+def _mk_engine(model, cfg, **kw):
+    base = dict(max_prompt_len=32, max_new_tokens=8, temperature=0.0,
+                page_size=4, max_batch_size=4)
+    base.update(kw)
+    return ContinuousBatchingEngine(model, cfg, RolloutConfig(**base),
+                                    eos_token_id=None, segment_len=4)
+
+
+def _serving_setup():
+    cfg = ModelConfig.tiny(dtype="float32")
+    model = Transformer(cfg)
+    params = init_params(model, jax.random.key(0), cfg)
+    return cfg, model, params
+
+
+def test_chunked_prefill_matches_oneshot():
+    """chunked_prefill_tokens splits admission across decode segments;
+    greedy output must equal the one-shot prefill bit-for-bit (the
+    chunk forward attends the gathered pool with the same mask)."""
+    cfg, model, params = _serving_setup()
+    rng = np.random.RandomState(0)
+    prompts = [rng.randint(1, cfg.vocab_size, n).astype(np.int32)
+               for n in (30, 17, 5, 26, 9, 31)]
+    reqs = [(i, p) for i, p in enumerate(prompts)]
+    one = _mk_engine(model, cfg, prefix_cache=False)
+    base = {r.req_id: r for r in one.generate(reqs, jax.random.key(1),
+                                              params)}
+    chunked = _mk_engine(model, cfg, prefix_cache=False,
+                         chunked_prefill_tokens=8)
+    out = {r.req_id: r for r in chunked.generate(reqs, jax.random.key(1),
+                                                 params)}
+    assert sorted(out) == sorted(base)
+    for i in base:
+        np.testing.assert_array_equal(out[i].tokens, base[i].tokens,
+                                      err_msg=f"req {i}")
+        np.testing.assert_array_equal(out[i].logprobs, base[i].logprobs)
+
+
+def test_prefix_cache_bit_exact_trajectories():
+    """prefix_cache on/off must produce IDENTICAL trajectories —
+    tokens and logprobs bitwise, at temperature 1.0, including the
+    second pass where the cache actually hits (mirroring the
+    group_prefix_sharing guarantee: cached pages hold KV bit-identical
+    to what a fresh prefill would write)."""
+    cfg, model, params = _serving_setup()
+    rng = np.random.RandomState(2)
+    pref = rng.randint(1, cfg.vocab_size, 12).astype(np.int32)
+    prompts = [np.concatenate(
+        [pref, rng.randint(1, cfg.vocab_size, n).astype(np.int32)])
+        for n in (4, 9, 2, 14)]
+    reqs = [(i, p) for i, p in enumerate(prompts)]
+    on = _mk_engine(model, cfg, prefix_cache=True, temperature=1.0)
+    off = _mk_engine(model, cfg, prefix_cache=False, temperature=1.0)
+    for key in (jax.random.key(5), jax.random.key(6)):
+        o_on = {r.req_id: r for r in on.generate(reqs, key, params)}
+        o_off = {r.req_id: r for r in off.generate(reqs, key, params)}
+        for i in o_on:
+            np.testing.assert_array_equal(o_on[i].tokens, o_off[i].tokens,
+                                          err_msg=f"req {i}")
+            np.testing.assert_array_equal(o_on[i].logprobs,
+                                          o_off[i].logprobs)
+    # pass 2 actually exercised the cache (retired pages graduated)
+    assert on.sched.cached_total > 0
+    assert off.sched.cached_total == 0
+
+
+def test_prefix_cache_cleared_on_new_weights():
+    """Cached KV is weight-dependent: installing new weights must drop
+    the cache (a stale hit would decode against old-weights KV)."""
+    cfg, model, params = _serving_setup()
+    eng = _mk_engine(model, cfg, prefix_cache=True)
+    rng = np.random.RandomState(3)
+    reqs = [(0, rng.randint(1, cfg.vocab_size, 20).astype(np.int32))]
+    eng.generate(reqs, jax.random.key(0), params)
+    assert eng.sched.cached_total > 0
+    params2 = init_params(model, jax.random.key(1), cfg)
+    eng.load_weights(params2)
+    assert eng.sched.cached_total == 0
+    # and the post-reload trajectory equals a fresh engine's
+    out = eng.generate(reqs, jax.random.key(2), params2)[0]
+    fresh = _mk_engine(model, cfg, prefix_cache=True)
+    expect = fresh.generate(reqs, jax.random.key(2), params2)[0]
+    np.testing.assert_array_equal(out.tokens, expect.tokens)
+
+
+def test_preemption_restart_recompute():
+    """A pool too small for every admitted request's growth preempts
+    the youngest decoding request (restart-by-recompute); greedy
+    restarts reproduce the same completion, nothing is lost."""
+    cfg, model, params = _serving_setup()
+    rng = np.random.RandomState(4)
+    prompts = [rng.randint(1, cfg.vocab_size, 9).astype(np.int32)
+               for _ in range(4)]
+    reqs = [(i, p) for i, p in enumerate(prompts)]
+    tight = _mk_engine(model, cfg, prefix_cache=False, num_pages=12,
+                       page_watermark=0, max_prompt_len=16)
+    out = {r.req_id: r for r in tight.generate(reqs, jax.random.key(3),
+                                               params)}
+    assert tight.preemptions > 0
+    ample = _mk_engine(model, cfg, prefix_cache=False, max_prompt_len=16)
+    base = {r.req_id: r for r in ample.generate(reqs, jax.random.key(3),
+                                                params)}
+    assert sorted(out) == sorted(base)
+    for i in base:
+        np.testing.assert_array_equal(out[i].tokens, base[i].tokens,
+                                      err_msg=f"req {i}")
+    assert tight.sched.running == 0 and tight.sched.waiting == 0
+    assert tight.sched.available_pages == 12
+
+
+def test_pool_too_small_raises():
+    cfg, model, params = _serving_setup()
+    eng = _mk_engine(model, cfg, num_pages=2, max_prompt_len=16)
+    import pytest
+
+    with pytest.raises(RuntimeError, match="too small"):
+        eng.generate([(0, np.ones(14, np.int32))], jax.random.key(0),
+                     params)
+
+
+def test_submit_step_service_surface():
+    """The standing-service API: requests submitted over time complete
+    across step() calls with the same outputs generate() produces."""
+    cfg, model, params = _serving_setup()
+    rng = np.random.RandomState(5)
+    prompts = [rng.randint(1, cfg.vocab_size, 5 + i).astype(np.int32)
+               for i in range(6)]
+    base_eng = _mk_engine(model, cfg, prefix_cache=False)
+    base = {r.req_id: r for r in base_eng.generate(
+        [(i, p) for i, p in enumerate(prompts)], jax.random.key(7),
+        params)}
+    svc = _mk_engine(model, cfg, prefix_cache=False)
+    svc.load_weights(params)
+    svc.reset_rng(jax.random.key(7))
+    done = {}
+    # trickle the requests in: two per wave, finish order is free
+    for i, p in enumerate(prompts[:2]):
+        svc.submit(i, p)
+    i_next = 2
+    waves = 0
+    while len(done) < len(prompts):
+        for r in svc.step():
+            done[r.req_id] = r
+        if i_next < len(prompts):
+            svc.submit(i_next, prompts[i_next])
+            i_next += 1
+        waves += 1
+        assert waves < 100
+    assert svc.pending == 0
+    assert sorted(done) == sorted(base)
+    # greedy: arrival timing cannot change any completion's content
+    for i in base:
+        np.testing.assert_array_equal(done[i].tokens, base[i].tokens,
+                                      err_msg=f"req {i}")
+
+
+def test_priority_admission_order():
+    """admission_policy='priority': when slots free up, the
+    higher-priority waiting request overtakes earlier arrivals."""
+    cfg, model, params = _serving_setup()
+    eng = _mk_engine(model, cfg, admission_policy="priority",
+                     max_batch_size=1, max_new_tokens=4)
+    rng = np.random.RandomState(6)
+    p = [rng.randint(1, cfg.vocab_size, 6).astype(np.int32)
+         for _ in range(3)]
+    eng.load_weights(params)
+    eng.reset_rng(jax.random.key(0))
+    eng.submit(0, p[0], priority=0)
+    eng.submit(1, p[1], priority=0)
+    eng.submit(2, p[2], priority=9)   # must overtake requests 0 and 1
+    order = []
+    waves = 0
+    while len(order) < 3:
+        order.extend(r.req_id for r in eng.step())
+        waves += 1
+        assert waves < 100
+    # highest priority first, then FIFO within the same class
+    assert order == [2, 0, 1]
+
+
+def test_pool_held_by_prefill_self_preempts_not_fatal():
+    """Pool exhausted while the holder is MID-CHUNKED-PREFILL (not a
+    preemptable decoding victim): the starved decoding request must
+    restart-by-recompute (self-preempt + requeue), not kill the
+    standing service with a fatal 'pool exhausted' raise."""
+    cfg, model, params = _serving_setup()
+    rng = np.random.RandomState(8)
+    short = rng.randint(1, cfg.vocab_size, 4).astype(np.int32)
+    long_p = rng.randint(1, cfg.vocab_size, 24).astype(np.int32)
+    # 9 pages: short admits with 2, long with 7 -> free 0; the short
+    # request's first growth fails while the long prompt is still
+    # chunking (6 waves at chunk=4).
+    tight = ContinuousBatchingEngine(
+        model, cfg, RolloutConfig(
+            max_prompt_len=24, max_new_tokens=16, temperature=0.0,
+            page_size=4, max_batch_size=2, num_pages=9,
+            page_watermark=0, prefix_cache=False,
+            chunked_prefill_tokens=4),
+        eos_token_id=None, segment_len=4)
+    reqs = [(0, short, 16), (1, long_p, 4)]
+    out = {r.req_id: r for r in tight.generate(reqs, jax.random.key(1),
+                                               params)}
+    assert sorted(out) == [0, 1]
+    assert tight.preemptions > 0
+    ample = _mk_engine(model, cfg, prefix_cache=False, max_prompt_len=24,
+                       max_new_tokens=16, max_batch_size=2)
+    base = {r.req_id: r for r in ample.generate(reqs, jax.random.key(1),
+                                                params)}
+    for i in base:
+        np.testing.assert_array_equal(out[i].tokens, base[i].tokens,
+                                      err_msg=f"req {i}")
+
+
+def test_admit_max_out_contract_parity():
+    """admit(max_out) is part of the shared contract: both impls cap a
+    wave identically."""
+    from orion_tpu.runtime import PyScheduler, Scheduler
+
+    for s in (PyScheduler(32, 4, 4), Scheduler(32, 4, 4)):
+        for i in range(4):
+            s.add(i, 4, 4)
+        first = s.admit(max_out=2)
+        assert [a[0] for a in first] == [0, 1]
+        rest = s.admit()
+        assert [a[0] for a in rest] == [2, 3]
+
+
+def test_generate_duplicate_ids_rejected_atomically():
+    """A duplicate (or in-flight-colliding) request id must fail BEFORE
+    anything is submitted — a mid-loop raise would leave earlier
+    requests enqueued and poison every later generate() call."""
+    import pytest
+
+    cfg, model, params = _serving_setup()
+    eng = _mk_engine(model, cfg)
+    p = np.ones(4, np.int32)
+    with pytest.raises(ValueError, match="already in flight"):
+        eng.generate([(1, p), (1, p)], jax.random.key(0), params)
+    # overlapping k-clone ranges collide too
+    with pytest.raises(ValueError, match="already in flight"):
+        eng.generate([(0, p, None, 3), (2, p)], jax.random.key(0), params)
+    assert eng.sched.waiting == 0 and eng.pending == 0
+    # the engine is NOT poisoned: a clean call returns exactly its ids
+    out = eng.generate([(1, p)], jax.random.key(1), params)
+    assert [r.req_id for r in out] == [1]

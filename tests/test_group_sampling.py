@@ -62,8 +62,10 @@ def test_group_greedy_matches_repeated():
 
 def test_group_page_accounting():
     """A k-clone group must reserve shared + k*private pages — NOT
-    k*total.  prompt_len=8, page_size=4 → 2 shared prompt pages;
-    max_new=8 → ceil(16/4)=4 total per solo clone, so private=2."""
+    k*total.  On-demand contract (PR 8): admission takes the prompt's
+    full pages (shared) + ONE private page per clone; growth arrives
+    via extend().  prompt_len=8, page_size=4 → 2 shared prompt pages;
+    max_new=8 → ceil(16/4)=4 total per clone at full growth."""
     cfg, model, params, eng = _setup(slots=8, max_new=8, max_prompt=12,
                                      page_size=4, num_pages=64)
     k = 8
@@ -71,8 +73,13 @@ def test_group_page_accounting():
     admitted = eng.sched.admit()
     assert len(admitted) == k
     used = 64 - eng.sched.free_pages
-    assert used == 2 + k * 2, used          # shared=2 + 8 clones × 2
-    # the naive path would have taken k * 4 = 32 pages
+    assert used == 2 + k * 1, used          # shared=2 + 8 clones × 1
+    # grow every clone to its full lifetime: + 1 more private page each
+    for rid, _ in admitted:
+        assert eng.sched.extend(rid, 16) == 1
+    used = 64 - eng.sched.free_pages
+    assert used == 2 + k * 2, used
+    # even fully grown, far below the naive k * total = 32
     assert used < k * 4
     # every clone's table starts with the SAME two physical pages
     tables = [eng.sched.pages(rid) for rid, _ in admitted]
@@ -148,7 +155,8 @@ def test_group_more_groups_than_slots():
         for j in range(k):
             np.testing.assert_array_equal(out[i * k + j].tokens, solo.tokens,
                                           err_msg=f"group {i} clone {j}")
-    assert eng.sched.free_pages == eng.num_pages
+    # every page recycled: free or parked unreferenced in the prefix cache
+    assert eng.sched.available_pages == eng.num_pages
     assert eng.sched.running == 0 and eng.sched.waiting == 0
 
 

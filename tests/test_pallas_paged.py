@@ -48,7 +48,8 @@ def _dense_ref(q, k_pages, v_pages, block_tables, seq_lens, scale):
 def test_paged_decode_matches_dense():
     q, kp, vp, bt, lens = _setup()
     scale = 0.25
-    out = paged_decode_attention(q, kp, vp, bt, lens, scale)
+    out = paged_decode_attention(q, kp, vp, bt, lens, scale,
+                                 force_kernel=True)
     ref = _dense_ref(q, kp, vp, bt, lens, scale)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=2e-5, atol=2e-5)
@@ -57,7 +58,8 @@ def test_paged_decode_matches_dense():
 def test_paged_decode_single_token_seq():
     q, kp, vp, bt, _ = _setup(seed=1)
     lens = jnp.asarray([1, 1, 1], jnp.int32)
-    out = paged_decode_attention(q, kp, vp, bt, lens, 0.25)
+    out = paged_decode_attention(q, kp, vp, bt, lens, 0.25,
+                                 force_kernel=True)
     ref = _dense_ref(q, kp, vp, bt, lens, 0.25)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=2e-5, atol=2e-5)
@@ -67,16 +69,44 @@ def test_paged_decode_ignores_padding_pages():
     """Tokens beyond seq_len must not contribute, whatever the padded
     block-table entries point at."""
     q, kp, vp, bt, lens = _setup(seed=2)
-    out1 = paged_decode_attention(q, kp, vp, bt, lens, 0.25)
+    out1 = paged_decode_attention(q, kp, vp, bt, lens, 0.25,
+                                  force_kernel=True)
     # Rewrite block-table entries beyond each sequence's last used page.
     ps = kp.shape[2]
     used = (np.asarray(lens) + ps - 1) // ps
     bt2 = np.asarray(bt).copy()
     for b in range(bt2.shape[0]):
         bt2[b, used[b]:] = 0
-    out2 = paged_decode_attention(q, kp, vp, jnp.asarray(bt2), lens, 0.25)
+    out2 = paged_decode_attention(q, kp, vp, jnp.asarray(bt2), lens,
+                                  0.25, force_kernel=True)
     np.testing.assert_allclose(np.asarray(out1), np.asarray(out2),
                                rtol=1e-6, atol=1e-6)
+
+
+def test_reference_twin_matches_kernel():
+    """The pure-XLA reference twin (the off-TPU execution path since
+    PR 8) must agree with the interpreted kernel, bf16-free f32 case
+    AND the int8-pool case (scale-on-scores / scale-on-probs order)."""
+    from orion_tpu.ops.pallas.paged_attention import (
+        paged_decode_attention_reference)
+    from orion_tpu.ops.quant import quantize_kv
+
+    q, kp, vp, bt, lens = _setup(seed=6)
+    ref = paged_decode_attention_reference(q, kp, vp, bt, lens, 0.25)
+    ker = paged_decode_attention(q, kp, vp, bt, lens, 0.25,
+                                 force_kernel=True)
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(ker),
+                               rtol=2e-5, atol=2e-5)
+    kq, ks = quantize_kv(kp)
+    vq, vs = quantize_kv(vp)
+    ks4, vs4 = ks[:, :, None, :], vs[:, :, None, :]
+    ref8 = paged_decode_attention_reference(q, kq, vq, bt, lens, 0.25,
+                                            k_scales=ks4, v_scales=vs4)
+    ker8 = paged_decode_attention(q, kq, vq, bt, lens, 0.25,
+                                  k_scales=ks4, v_scales=vs4,
+                                  force_kernel=True)
+    np.testing.assert_allclose(np.asarray(ref8), np.asarray(ker8),
+                               rtol=2e-5, atol=2e-5)
 
 
 def test_paged_decode_sharded_matches_plain():
@@ -128,7 +158,8 @@ def test_paged_decode_int8_matches_dequant_dense():
     kq, ks = quantize_kv(kp)          # [N,Hkv,ps,D], [N,Hkv,ps]
     vq, vs = quantize_kv(vp)
     ks4, vs4 = ks[:, :, None, :], vs[:, :, None, :]
-    out = paged_decode_attention_int8(q, kq, vq, ks4, vs4, bt, lens, 0.25)
+    out = paged_decode_attention_int8(q, kq, vq, ks4, vs4, bt, lens,
+                                      0.25, force_kernel=True)
     kd = np.asarray(kq, np.float32) * np.asarray(ks)[..., None]
     vd = np.asarray(vq, np.float32) * np.asarray(vs)[..., None]
     ref = _dense_ref(q, jnp.asarray(kd), jnp.asarray(vd), bt, lens, 0.25)

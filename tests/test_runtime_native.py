@@ -1,6 +1,13 @@
 """Native runtime scheduler tests: C++ implementation behavior + exact
-contract agreement with the pure-Python mirror (SURVEY.md §2 #5)."""
+contract agreement with the pure-Python mirror (SURVEY.md §2 #5).
 
+PR 8 contract: on-demand page allocation (prompt + 1 page at admit,
+``extend`` grows, ``preempt`` frees + requeues), watermark-gated
+admission under fifo / priority / deadline policies, and cross-request
+prefix caching (hash-matched pages shared read-only, refcounted,
+LRU-evicted at refs==0, graduated into the cache by ``finish``)."""
+
+import os
 import random
 
 import pytest
@@ -14,26 +21,35 @@ def test_native_builds_and_loads():
     assert native_available()
 
 
-def _impls():
-    yield PyScheduler(num_pages=16, page_size=4, max_slots=2)
+def _impls(**kw):
+    yield PyScheduler(num_pages=16, page_size=4, max_slots=2, **kw)
     if native_available():
-        yield Scheduler(num_pages=16, page_size=4, max_slots=2)
+        yield Scheduler(num_pages=16, page_size=4, max_slots=2, **kw)
 
 
 @pytest.mark.parametrize("sched", _impls(),
                          ids=lambda s: type(s).__name__)
-def test_admission_reserves_whole_lifetime(sched):
-    # prompt 6 + max_new 6 = 12 tokens -> 3 pages of 4
+def test_admission_on_demand(sched):
+    """Admission grants pages covering prompt + first token only
+    (full_prompt + 1 pages); growth arrives via extend()."""
+    # prompt 6 -> 1 full page + 1 private page each (positions 0..7)
     sched.add(1, 6, 6)
     sched.add(2, 6, 6)
-    sched.add(3, 6, 6)  # needs 3 pages; only 16-6=10 left after 1,2 but
+    sched.add(3, 6, 6)
     admitted = sched.admit()
     # 2 slots only -> third waits regardless of pages
     assert [a[0] for a in admitted] == [1, 2]
     assert sched.running == 2 and sched.waiting == 1
-    assert sched.free_pages == 16 - 6
-    assert len(sched.pages(1)) == 3
+    assert sched.free_pages == 16 - 4          # 2 pages per request
+    assert len(sched.pages(1)) == 2
     assert set(sched.pages(1)).isdisjoint(sched.pages(2))
+
+    # grow request 1 to its full lifetime (12 tokens -> 3 pages)
+    assert sched.extend(1, 12) == 1
+    assert len(sched.pages(1)) == 3
+    # already covered -> no-op; the cap is plen+max_new
+    assert sched.extend(1, 12) == 0
+    assert sched.extend(1, 999) == 0
 
     freed = sched.finish(1)
     assert freed == 3
@@ -44,11 +60,32 @@ def test_admission_reserves_whole_lifetime(sched):
 
 @pytest.mark.parametrize("sched", _impls(),
                          ids=lambda s: type(s).__name__)
+def test_extend_fails_clean_when_dry(sched):
+    """extend on an exhausted pool returns -1 WITHOUT allocating (the
+    engine preempts and retries); preempt requeues at arrival order."""
+    sched.add(1, 40, 24)   # 11 pages at admit (10 prompt + 1)
+    sched.add(2, 12, 24)   # 4 pages at admit
+    assert [a[0] for a in sched.admit()] == [1, 2]
+    assert sched.free_pages == 16 - 11 - 4
+    assert sched.extend(1, 64) == -1           # needs 5, has 1
+    assert len(sched.pages(1)) == 11           # nothing allocated
+    before = sched.pages(1)
+    sched.preempt(2)                           # victim frees its 4
+    assert sched.running == 1 and sched.waiting == 1
+    assert sched.extend(1, 64) == 5
+    assert sched.pages(1)[:11] == before
+    sched.finish(1)
+    # preempted request readmits at its original queue position
+    assert [a[0] for a in sched.admit()] == [2]
+
+
+@pytest.mark.parametrize("sched", _impls(),
+                         ids=lambda s: type(s).__name__)
 def test_fifo_no_overtaking(sched):
-    sched.add(1, 40, 20)   # 15 pages — fits (16 free)
+    sched.add(1, 40, 20)   # 11 pages at admit
     admitted = sched.admit()
     assert [a[0] for a in admitted] == [1]
-    sched.add(2, 40, 20)   # 15 pages — cannot fit now (1 free)
+    sched.add(2, 40, 20)   # 11 pages — cannot fit now (5 free)
     sched.add(3, 2, 2)     # 1 page — would fit, but FIFO: must not overtake
     assert sched.admit() == []
     assert sched.waiting == 2
@@ -57,39 +94,172 @@ def test_fifo_no_overtaking(sched):
     assert [a[0] for a in admitted] == [2, 3]
 
 
-def test_native_matches_python_randomized():
-    if not native_available():
-        pytest.skip("no toolchain")
-    rng = random.Random(0)
-    a = Scheduler(num_pages=64, page_size=8, max_slots=4)
-    b = PyScheduler(num_pages=64, page_size=8, max_slots=4)
-    assert type(a).__name__ != type(b).__name__
-    live = []
-    next_id = 0
-    for _ in range(300):
+@pytest.mark.parametrize("mk", [PyScheduler, Scheduler])
+def test_priority_and_deadline_policies(mk):
+    s = mk(32, 4, 1, watermark=0, policy="priority")
+    s.add(1, 4, 4, priority=0)
+    s.add(2, 4, 4, priority=5)
+    s.add(3, 4, 4, priority=5)
+    # highest priority first; FIFO tiebreak within a priority class
+    assert [a[0] for a in s.admit()] == [2]
+    s.finish(2)
+    assert [a[0] for a in s.admit()] == [3]
+    s.finish(3)
+    assert [a[0] for a in s.admit()] == [1]
+
+    s = mk(32, 4, 1, watermark=0, policy="deadline")
+    s.add(1, 4, 4)                   # no deadline -> sorts last
+    s.add(2, 4, 4, deadline=100)
+    s.add(3, 4, 4, deadline=7)
+    assert [a[0] for a in s.admit()] == [3]   # EDF
+    s.finish(3)
+    assert [a[0] for a in s.admit()] == [2]
+    s.finish(2)
+    assert [a[0] for a in s.admit()] == [1]
+
+
+@pytest.mark.parametrize("mk", [PyScheduler, Scheduler])
+def test_watermark_holds_back_pages(mk):
+    """Admission keeps `watermark` pages in reserve for in-flight
+    growth — except for the first request into an empty scheduler,
+    which may always use the whole pool (no deadlock)."""
+    s = mk(8, 4, 4, watermark=4)
+    s.add(1, 20, 4)                  # needs 6 pages > 8 - watermark...
+    assert [a[0] for a in s.admit()] == [1]   # ...but pool is empty: ok
+    s.add(2, 4, 4)                   # needs 2, free 2, reserve 4 -> wait
+    assert s.admit() == []
+    # growth ignores the watermark: that is what the reserve is FOR
+    assert s.extend(1, 24) == 0      # capped at plen+max_new = 24 -> 6
+    s.finish(1)
+    assert [a[0] for a in s.admit()] == [2]
+
+
+@pytest.mark.parametrize("mk", [PyScheduler, Scheduler])
+def test_prefix_cache_share_and_graduate(mk):
+    """finish() graduates hashed full prompt pages into the cache; a
+    later add with matching hashes shares them (cached_count) and
+    allocates only the divergent tail.  clear_cache drops everything
+    unreferenced back to the free list."""
+    s = mk(32, 4, 2, watermark=0)
+    h = (11, 22, 33)                 # 3 full pages of a 13-token prompt
+    s.add(1, 13, 4, prefix_hashes=h)
+    assert [a[0] for a in s.admit()] == [1]
+    assert s.cached_count(1) == 0
+    p1 = s.pages(1)
+    s.finish(1)
+    # pages 0..2 (the hashed full prompt pages) are cached, not free
+    assert s.cached_total == 3
+    assert s.free_pages == 32 - 3
+    assert s.available_pages == 32
+
+    # same prefix, longer prompt: shares the 3 cached pages read-only
+    s.add(2, 17, 4, prefix_hashes=h + (44,))
+    assert [a[0] for a in s.admit()] == [2]
+    assert s.cached_count(2) == 3
+    assert s.pages(2)[:3] == p1[:3]
+    # while referenced, cached pages cannot be evicted or cleared
+    assert s.clear_cache() == 0
+    s.finish(2)
+    # the orphaned (cleared-while-referenced) pages free on last unref
+    assert s.cached_total == 1       # page for hash 44 graduated
+    s.clear_cache()
+    assert s.free_pages == 32 and s.cached_total == 0
+
+
+@pytest.mark.parametrize("mk", [PyScheduler, Scheduler])
+def test_prefix_cache_lru_eviction(mk):
+    """Unreferenced cached pages are an LRU pool the allocator evicts
+    before failing — the cache can never deadlock admission."""
+    s = mk(4, 4, 2, watermark=0)
+    s.add(1, 9, 3, prefix_hashes=(7, 8))
+    assert [a[0] for a in s.admit()] == [1]
+    s.finish(1)                      # 2 pages cached, 2 free...
+    assert s.cached_total == 2 and s.available_pages == 4
+    s.add(2, 9, 7, prefix_hashes=(9, 10))   # no match: needs 3 fresh
+    assert [a[0] for a in s.admit()] == [2]
+    # one cached page was evicted (LRU) to satisfy the allocation
+    assert s.cached_total == 1
+    assert s.free_pages == 0
+
+
+def _drive(a, b, seed, policy, max_k=4, n_ops=700):
+    """Randomized step-for-step cross-check of the full PR 8 contract:
+    solo + group adds with priorities/deadlines/prefix hashes, admit,
+    extend, preempt, finish, clear_cache."""
+    rng = random.Random(seed)
+    hash_pool = [int(rng.getrandbits(62)) for _ in range(14)]
+    live, next_id = [], 0
+    for step in range(n_ops):
         op = rng.random()
-        if op < 0.5:
-            plen, mnew = rng.randint(1, 60), rng.randint(1, 60)
-            a.add(next_id, plen, mnew)
-            b.add(next_id, plen, mnew)
-            next_id += 1
-        elif op < 0.8:
+        if op < 0.35:
+            plen, mnew = rng.randint(1, 40), rng.randint(1, 20)
+            prio = rng.randint(0, 3)
+            dl = rng.choice([-1, rng.randint(0, 60)])
+            nh = rng.randint(0, max(0, (plen - 1) // 4))
+            hs = [rng.choice(hash_pool) for _ in range(nh)]
+            k = rng.randint(1, max_k)
+            if k == 1:
+                a.add(next_id, plen, mnew, prio, dl, hs)
+                b.add(next_id, plen, mnew, prio, dl, hs)
+            else:
+                a.add_group(next_id, plen, mnew, k, prio, dl, hs)
+                b.add_group(next_id, plen, mnew, k, prio, dl, hs)
+            next_id += k
+        elif op < 0.6:
             ra, rb = a.admit(), b.admit()
             assert ra == rb
-            for req_id, slot in ra:
-                assert a.pages(req_id) == b.pages(req_id)
-                assert a.slot(req_id) == b.slot(req_id) == slot
-                live.append(req_id)
-        elif live:
-            req_id = live.pop(rng.randrange(len(live)))
-            assert a.finish(req_id) == b.finish(req_id)
-        assert (a.free_pages, a.waiting, a.running) == \
-            (b.free_pages, b.waiting, b.running)
+            for rid, slot in ra:
+                assert a.pages(rid) == b.pages(rid)
+                assert a.slot(rid) == b.slot(rid) == slot
+                assert a.cached_count(rid) == b.cached_count(rid)
+                assert a.shared_count(rid) == b.shared_count(rid)
+                live.append(rid)
+        elif op < 0.75 and live:
+            rid = rng.choice(live)
+            t = rng.randint(1, 70)
+            assert a.extend(rid, t) == b.extend(rid, t)
+            assert a.pages(rid) == b.pages(rid)
+        elif op < 0.92 and live:
+            rid = live.pop(rng.randrange(len(live)))
+            if rng.random() < 0.3:
+                a.preempt(rid)
+                b.preempt(rid)
+            else:
+                assert a.finish(rid) == b.finish(rid)
+        elif op < 0.95:
+            assert a.clear_cache() == b.clear_cache()
+        assert (a.free_pages, a.available_pages, a.cached_total,
+                a.waiting, a.running) == \
+               (b.free_pages, b.available_pages, b.cached_total,
+                b.waiting, b.running), (policy, seed, step)
+
+
+def test_native_matches_python_randomized():
+    """Seeded property test: the native and Python schedulers agree
+    STEP FOR STEP under the full recycle/prefix/policy contract."""
+    if not native_available():
+        pytest.skip("no toolchain")
+    from orion_tpu.runtime.scheduler import _NativeScheduler
+
+    rng = random.Random(0)
+    for trial in range(6):
+        n_pages = rng.randint(8, 64)
+        ps = rng.choice([2, 4, 8])
+        slots = rng.randint(2, 8)
+        wm = rng.randint(0, 4)
+        policy = rng.choice(["fifo", "priority", "deadline"])
+        a = _NativeScheduler(n_pages, ps, slots, watermark=wm,
+                             policy=policy)
+        b = PyScheduler(n_pages, ps, slots, watermark=wm, policy=policy)
+        assert type(a).__name__ != type(b).__name__
+        _drive(a, b, seed=trial, policy=policy, max_k=min(4, slots))
 
 
 def test_bad_params_and_unknown_ids():
     with pytest.raises((ValueError, RuntimeError)):
         PyScheduler(0, 4, 2)
+    with pytest.raises((ValueError, RuntimeError)):
+        PyScheduler(8, 4, 2, policy="nope")
     s = Scheduler(8, 4, 2)
     if native_available():
         with pytest.raises(ValueError):
@@ -98,50 +268,10 @@ def test_bad_params_and_unknown_ids():
         s.pages(99)
     with pytest.raises(KeyError):
         s.finish(99)
-
-
-def test_native_matches_python_groups_randomized():
-    """Group-admission cross-check (VERDICT r4 missing #3): native and
-    Python schedulers must agree on atomic group admission, shared-page
-    refcounting, and the exact free-list order under a random mix of
-    solo and group requests."""
-    if not native_available():
-        pytest.skip("no native toolchain")
-    from orion_tpu.runtime.scheduler import _NativeScheduler
-
-    rng = random.Random(42)
-    for trial in range(8):
-        n_pages = rng.randint(8, 48)
-        ps = rng.choice([2, 4, 8])
-        slots = rng.randint(2, 8)
-        a = _NativeScheduler(n_pages, ps, slots)
-        b = PyScheduler(n_pages, ps, slots)
-        next_id, live = 0, []
-        for _ in range(300):
-            op = rng.random()
-            if op < 0.4:
-                k = rng.randint(1, slots)
-                plen, mnew = rng.randint(1, 30), rng.randint(1, 15)
-                if k == 1:
-                    a.add(next_id, plen, mnew)
-                    b.add(next_id, plen, mnew)
-                else:
-                    a.add_group(next_id, plen, mnew, k)
-                    b.add_group(next_id, plen, mnew, k)
-                next_id += k
-            elif op < 0.7:
-                ra, rb = a.admit(), b.admit()
-                assert ra == rb
-                for req_id, slot in ra:
-                    assert a.pages(req_id) == b.pages(req_id)
-                    assert a.shared_count(req_id) == \
-                        b.shared_count(req_id)
-                    live.append(req_id)
-            elif live:
-                req_id = live.pop(rng.randrange(len(live)))
-                assert a.finish(req_id) == b.finish(req_id)
-            assert (a.free_pages, a.waiting, a.running) == \
-                (b.free_pages, b.waiting, b.running)
+    with pytest.raises(KeyError):
+        s.extend(99, 4)
+    with pytest.raises(KeyError):
+        s.preempt(99)
 
 
 def test_group_rejects_oversized_k():
@@ -151,3 +281,37 @@ def test_group_rejects_oversized_k():
     s2 = PyScheduler(32, 4, 4)
     with pytest.raises(ValueError, match="never be admitted"):
         s2.add_group(0, 4, 4, 5)
+
+
+def test_compile_failure_memoized(tmp_path, monkeypatch):
+    """A toolchain-less box must pay the g++ attempt ONCE per source
+    hash — not a 120 s-timeout subprocess per Scheduler() construction
+    (satellite: negative-result memoization)."""
+    import orion_tpu.runtime.scheduler as sch
+
+    calls = []
+    real_run = sch.subprocess.run
+
+    def failing_run(*args, **kw):
+        calls.append(1)
+        raise OSError("no g++")
+
+    monkeypatch.setattr(sch.subprocess, "run", failing_run)
+    monkeypatch.setattr(sch, "_BUILD_DIR", str(tmp_path))
+    monkeypatch.setattr(sch, "_SO", str(tmp_path / "lib.so"))
+    monkeypatch.setattr(sch, "_FAIL", str(tmp_path / "lib.so.fail"))
+    monkeypatch.setattr(sch, "_lib", None)
+    monkeypatch.setattr(sch, "_load_failed_hash", None)
+
+    assert not sch.native_available()
+    assert len(calls) == 1
+    # same-process negative memo: no further subprocess attempts
+    for _ in range(3):
+        assert isinstance(sch.Scheduler(8, 4, 2), sch.PyScheduler)
+    assert len(calls) == 1
+    # cross-process memo: a fresh process state (cleared globals) hits
+    # the .fail sentinel instead of re-running the compiler
+    monkeypatch.setattr(sch, "_load_failed_hash", None)
+    assert not sch.native_available()
+    assert len(calls) == 1
+    assert os.path.exists(str(tmp_path / "lib.so.fail"))
