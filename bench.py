@@ -257,7 +257,7 @@ def main() -> None:
         h = trainer.train(iter([batch() for _ in range(iters)]),
                           num_iterations=iters)
         jax.block_until_ready(trainer.state.params)
-        dt = time.perf_counter() - t0
+        dt = time.perf_counter() - t0  # orion: ignore[naked-timer] the bench wall window IS the metric (params blocked above)
         wc = n_samples * iters / dt
         # Copy the window's slice: trainer.train returns the trainer's
         # shared metrics_history, so a retry would otherwise mutate the
@@ -321,6 +321,15 @@ def main() -> None:
             json.dump(base, f, indent=1)
     vs = value / base[key] if base[key] else 1.0
 
+    # Per-iteration rate distribution via the obs Histogram machinery
+    # (ISSUE 9): the p50/p95 spread makes a tunnel-stall window
+    # readable straight off the JSON line.
+    from orion_tpu.utils.metrics import Histogram
+
+    rate_hist = Histogram()
+    for r in rates:
+        rate_hist.record(r)
+
     out = {
         "metric": f"{algo.upper()} samples/sec (rollout+update), "
                   f"preset={name} ({n_params/1e9:.2f}B params, "
@@ -336,6 +345,8 @@ def main() -> None:
         "rollout_batch_size": cfg.rollout_batch_size,
         "minibatch_size": cfg.minibatch_size,
     }
+    out.update({k: round(float(v), 3)
+                for k, v in rate_hist.summary("iter_samples_per_sec").items()})
     if backend_err:
         # CPU-fallback run on a sick chip: the number is real but NOT
         # the TPU headline — mark it so the artifact can't be misread.

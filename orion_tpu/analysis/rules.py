@@ -838,6 +838,97 @@ def _check_unsupervised_thread(ctx: ModuleContext):
 
 
 # ---------------------------------------------------------------------------
+# rule: naked-timer
+# ---------------------------------------------------------------------------
+
+_TIMER_CALLS = {"time.time", "time.monotonic", "time.perf_counter"}
+
+
+def _scope_walk(root: ast.AST):
+    """Walk one function scope (or the module top level) WITHOUT
+    descending into nested function/class bodies — each nested def
+    gets its own independent scan, so timer variables never leak
+    across scopes."""
+    stack = list(ast.iter_child_nodes(root))
+    while stack:
+        node = stack.pop()
+        yield node
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef, ast.Lambda)):
+            stack.extend(ast.iter_child_nodes(node))
+
+
+@rule("naked-timer",
+      "wall-clock delta (time.time/monotonic/perf_counter subtraction) "
+      "used for timing outside orion_tpu/obs — invisible to the span "
+      "timeline (deadline comparisons are exempt)")
+def _check_naked_timer(ctx: ModuleContext):
+    # obs IS the timing layer; tests time freely (their scaffolding is
+    # not the product's observability surface).
+    p = ctx.path.replace(os.sep, "/")
+    base = os.path.basename(p)
+    if "orion_tpu/obs/" in p or "tests/" in p or \
+            base.startswith("test_") or base == "conftest.py":
+        return
+
+    def is_timer_call(node: ast.AST) -> bool:
+        return isinstance(node, ast.Call) and \
+            ctx.dotted(node.func) in _TIMER_CALLS
+
+    findings: List[Finding] = []
+
+    def scan_scope(root: ast.AST) -> None:
+        tainted: Set[str] = set()
+        for node in _scope_walk(root):
+            # taint only PURE timer assignments (x = time.monotonic());
+            # `deadline = time.monotonic() + timeout` is a deadline,
+            # not a timestamp, and stays clean.
+            if isinstance(node, ast.Assign) and is_timer_call(node.value):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        tainted.add(t.id)
+        exempt: Set[int] = set()
+        for node in _scope_walk(root):
+            if isinstance(node, ast.Compare):
+                # `now - start > timeout` is a deadline/stall CHECK,
+                # not a measurement — every Sub under a Compare is
+                # exempt.
+                for sub in ast.walk(node):
+                    if isinstance(sub, ast.BinOp) and \
+                            isinstance(sub.op, ast.Sub):
+                        exempt.add(id(sub))
+
+        def timer_read(e: ast.AST) -> bool:
+            return is_timer_call(e) or (isinstance(e, ast.Name)
+                                        and e.id in tainted)
+
+        for node in _scope_walk(root):
+            if isinstance(node, ast.BinOp) and \
+                    isinstance(node.op, ast.Sub) and \
+                    id(node) not in exempt and \
+                    timer_read(node.left) and timer_read(node.right):
+                findings.append(Finding(
+                    "naked-timer", ctx.path, node.lineno,
+                    "raw timer delta used for timing",
+                    hint="route through orion_tpu.obs spans — `with "
+                         "obs.timed(name) as sp: ...; sp.duration` "
+                         "measures even with tracing off AND lands the "
+                         "scope on the Perfetto timeline; benches that "
+                         "deliberately time wall windows justify with "
+                         "# orion: ignore[naked-timer]"))
+
+    for node in ctx.walk():
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            scan_scope(node)
+    scan_scope(ctx.tree)
+    seen: Set[Tuple[int, str]] = set()
+    for f in findings:
+        if (f.line, f.message) not in seen:
+            seen.add((f.line, f.message))
+            yield f
+
+
+# ---------------------------------------------------------------------------
 # rule: raw-socket
 # ---------------------------------------------------------------------------
 

@@ -397,6 +397,37 @@ class DataConfig:
 
 
 @dataclass
+class ObsConfig:
+    """Observability (orion_tpu.obs): span tracing + flight recorder.
+
+    Off by default — every call site is instrumented unconditionally,
+    but a disabled tracer is a shared no-op (the overhead budget test
+    holds the serving loop to <1%).  Armed at trainer construction,
+    released by ``trainer.close()``.
+    """
+
+    # Enable span/event tracing: spans land in the per-process ring
+    # and export as Chrome trace_event JSON (Perfetto-loadable,
+    # alongside the jax.profiler xplane dumps).
+    trace: bool = False
+    # Per-process event ring capacity (events, not bytes); the flight
+    # recorder dumps exactly this window.
+    ring_size: int = 4096
+    # Dump the ring to <trace_dir or log_dir>/flightrec-<ts>.json on
+    # unhandled exception, degradation-ladder transitions, or SIGUSR1.
+    # Needs `trace` on and a directory to write into.
+    flight_recorder: bool = True
+    # Where traces/flight dumps land; None => cfg.log_dir (dumps sit
+    # next to metrics.jsonl).
+    trace_dir: Optional[str] = None
+
+    def __post_init__(self) -> None:
+        if self.ring_size < 1:
+            raise ValueError(
+                f"obs.ring_size must be >= 1, got {self.ring_size}")
+
+
+@dataclass
 class ResilienceConfig:
     """Fault handling for the whole stack (orion_tpu.resilience).
 
@@ -545,6 +576,9 @@ class TrainConfig:
     # Fault handling (orion_tpu.resilience): supervisor budgets,
     # retries, quarantine, and the deterministic fault-injection plan.
     resilience: ResilienceConfig = field(default_factory=ResilienceConfig)
+    # Observability (orion_tpu.obs): span tracing, Perfetto export,
+    # and the crash flight recorder.
+    obs: ObsConfig = field(default_factory=ObsConfig)
 
 
 @dataclass

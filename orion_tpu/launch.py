@@ -246,13 +246,22 @@ def main(argv: Optional[list] = None) -> Any:
             trainer = build_trainer(algo, cfg, mesh, tokenizer)
             trainer.resume(prompt_iter, eval_iter=eval_iter)
             orch = AsyncOrchestrator(trainer, rollout_devs)
-            return orch.train(prompt_iter, eval_iter=eval_iter)
+            try:
+                return orch.train(prompt_iter, eval_iter=eval_iter)
+            finally:
+                # Route the exit through the trainer's sinks (metrics
+                # writer flush+close, obs tracer/flight recorder,
+                # recompile sentinel) — crash or clean.
+                trainer.close()
 
     mesh = make_mesh(cfg.mesh)
     with mesh:
         trainer = build_trainer(algo, cfg, mesh, tokenizer)
         trainer.resume(prompt_iter, eval_iter=eval_iter)
-        return trainer.train(prompt_iter, eval_iter=eval_iter)
+        try:
+            return trainer.train(prompt_iter, eval_iter=eval_iter)
+        finally:
+            trainer.close()
 
 
 if __name__ == "__main__":

@@ -1,0 +1,137 @@
+"""Crash flight recorder: dump the tracing ring to disk at the moment
+something goes wrong (ISSUE 9 tentpole (d)).
+
+A long unattended run dies with a two-line log ("worker died, pool
+degraded") and the forensic context — what every thread was doing in
+the seconds before — is gone.  The recorder turns that moment into a
+replayable timeline: ``dump(reason)`` writes
+``<directory>/flightrec-<ts>-<n>.json`` holding the last ``ring_size``
+events in Chrome ``trace_event`` form (open the file directly in
+Perfetto) plus the trigger reason and any supervisor state the caller
+attaches.
+
+Triggers, wired by the rest of the tree:
+
+- **unhandled exception** escaping a training loop
+  (``BaseTrainer.train`` and both orchestrators dump before
+  re-raising; :meth:`FlightRecorder.install` also chains
+  ``sys.excepthook`` for script-level crashes);
+- **degradation-ladder transitions** — a pool worker marked dead
+  (``WorkerPool._mark_dead``), a supervisor restart, and the
+  degrade-to-sync rung all call :func:`orion_tpu.obs.flight_dump`;
+- **SIGUSR1** — the operator's "show me what you're doing" poke on a
+  live process (main-thread installs only; harmless elsewhere).
+
+Dumping must never make a bad day worse: :func:`flight_dump` (the
+module-global entry in ``orion_tpu.obs``) swallows recorder errors.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import signal
+import sys
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+_LOG = logging.getLogger(__name__)
+
+__all__ = ["FlightRecorder"]
+
+
+class FlightRecorder:
+    """Dumps a :class:`~orion_tpu.obs.trace.Tracer` ring on demand."""
+
+    def __init__(self, directory: str, tracer=None):
+        self.directory = os.path.abspath(directory)
+        os.makedirs(self.directory, exist_ok=True)
+        self._tracer = tracer
+        self.dumps: List[str] = []
+        self._prev_excepthook = None
+        self._prev_sigusr1 = None
+        self._installed = False
+
+    def _resolve_tracer(self):
+        if self._tracer is not None:
+            return self._tracer
+        from orion_tpu.obs import get_tracer
+
+        return get_tracer()
+
+    # -- the one verb ---------------------------------------------------
+    def dump(self, reason: str, extra: Optional[Dict[str, Any]] = None
+             ) -> str:
+        """Write the ring + trigger context; returns the path.  The
+        file is itself Perfetto-loadable (top-level ``traceEvents``)."""
+        tracer = self._resolve_tracer()
+        stamp = time.strftime("%Y%m%d-%H%M%S")
+        # pid in the NAME, not just the body: a pool job's learner and
+        # worker processes share one log_dir, and two dumps in the
+        # same second (a process-group SIGUSR1, a fault's worker-side
+        # excepthook racing the learner's _mark_dead) must never
+        # overwrite each other's forensics.
+        path = os.path.join(
+            self.directory,
+            f"flightrec-{stamp}-{os.getpid()}-{len(self.dumps)}.json")
+        doc = {
+            "reason": reason,
+            "wall_time": time.time(),
+            "pid": os.getpid(),
+            "trace_id": str(tracer.trace_id),
+            "extra": extra or {},
+            "traceEvents": tracer.chrome_events(),
+        }
+        with open(path, "w") as f:
+            json.dump(doc, f)
+        self.dumps.append(path)
+        _LOG.warning("flight recorder: dumped %d events to %s (%s)",
+                     max(len(doc["traceEvents"]) - 1, 0), path, reason)
+        return path
+
+    # -- process-level triggers -----------------------------------------
+    def install(self, excepthook: bool = True,
+                sigusr1: bool = True) -> "FlightRecorder":
+        """Chain into ``sys.excepthook`` and (main thread only)
+        ``SIGUSR1``.  Idempotent; ``uninstall`` restores both."""
+        if self._installed:
+            return self
+        if excepthook:
+            self._prev_excepthook = sys.excepthook
+
+            def hook(exc_type, exc, tb):
+                try:
+                    self.dump("unhandled-exception",
+                              {"error": f"{exc_type.__name__}: {exc}"})
+                except Exception:  # the crash must still surface
+                    pass
+                (self._prev_excepthook or sys.__excepthook__)(
+                    exc_type, exc, tb)
+
+            sys.excepthook = hook
+        if sigusr1 and hasattr(signal, "SIGUSR1") and \
+                threading.current_thread() is threading.main_thread():
+            try:
+                self._prev_sigusr1 = signal.signal(
+                    signal.SIGUSR1,
+                    lambda signum, frame: self.dump("SIGUSR1"))
+            except (ValueError, OSError):  # pragma: no cover
+                self._prev_sigusr1 = None
+        self._installed = True
+        return self
+
+    def uninstall(self) -> None:
+        if not self._installed:
+            return
+        if self._prev_excepthook is not None:
+            sys.excepthook = self._prev_excepthook
+            self._prev_excepthook = None
+        if self._prev_sigusr1 is not None:
+            try:
+                signal.signal(signal.SIGUSR1, self._prev_sigusr1)
+            except (ValueError, OSError):  # pragma: no cover
+                pass
+            self._prev_sigusr1 = None
+        self._installed = False

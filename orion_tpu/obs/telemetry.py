@@ -1,0 +1,133 @@
+"""Request-lifecycle telemetry for the serving engine (ISSUE 9
+tentpole (c)).
+
+The continuous engine's host loop knows every lifecycle transition —
+submit, admit, first token, preempt, finish — but until now it only
+counted preemptions.  This module owns the clocks and the aggregation
+so the engine itself stays free of naked timers (the ``naked-timer``
+analysis rule bans raw ``time.*`` deltas outside ``orion_tpu/obs/``):
+
+- :meth:`RequestTelemetry.mark` records a monotonic timestamp per
+  (request, stage) and emits a tracing instant (``req.<stage>``) when
+  the global tracer is enabled;
+- derived latencies land in :class:`~orion_tpu.utils.metrics.Histogram`
+  instances — queue wait (submit→admit), TTFT (submit→first token),
+  decode tokens/sec — whose p50/p95/p99 summaries flow through
+  ``MetricsWriter`` and the serving bench JSON;
+- per-wave gauges (page-pool occupancy) and per-admission ratios
+  (prefix-cache hit fraction) ride the same histogram machinery.
+
+Pure host code; costs a dict write + one clock read per lifecycle
+transition (per REQUEST, not per token), which is noise next to a
+single decode segment dispatch.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, Optional
+
+from orion_tpu.utils.metrics import Counter, Histogram
+
+__all__ = ["RequestTelemetry"]
+
+
+class RequestTelemetry:
+    """Lifecycle clocks + histograms for a stream of requests."""
+
+    def __init__(self):
+        self._marks: Dict[int, Dict[str, float]] = {}
+        self.queue_wait_s = Histogram()
+        self.ttft_s = Histogram()
+        self.tok_per_s = Histogram()
+        self.prefix_hit_ratio = Histogram()
+        self.page_occupancy = Histogram()
+        self.finished = Counter()
+        self.preempted = Counter()
+
+    def _instant(self, name: str, **attrs) -> None:
+        from orion_tpu.obs import instant
+
+        instant(name, **attrs)
+
+    # -- lifecycle marks -------------------------------------------------
+    def mark(self, req_id: int, stage: str, **attrs) -> None:
+        """Record a lifecycle transition.  Stages with derived
+        latencies: ``admit`` records queue wait, ``first_token``
+        records TTFT (both relative to ``submit``)."""
+        t = time.monotonic()
+        m = self._marks.setdefault(req_id, {})
+        m[stage] = t
+        self._instant(f"req.{stage}", req=int(req_id), **attrs)
+        if stage == "admit" and "submit" in m:
+            self.queue_wait_s.record(t - m["submit"])
+        elif stage == "first_token" and "submit" in m:
+            self.ttft_s.record(t - m["submit"])
+
+    def preempt(self, req_id: int) -> None:
+        """Restart-by-recompute: the request goes back to waiting, so
+        its admit/first-token marks are dropped — the re-admission
+        measures a fresh queue wait and TTFT (the restart's real
+        latency cost, which is the point of recording it)."""
+        self.preempted.add()
+        m = self._marks.get(req_id)
+        if m is not None:
+            m.pop("admit", None)
+            m.pop("first_token", None)
+        self._instant("req.preempt", req=int(req_id))
+
+    def finish(self, req_id: int, n_tokens: int) -> None:
+        t = time.monotonic()
+        m = self._marks.pop(req_id, {})
+        self.finished.add()
+        ft = m.get("first_token")
+        if ft is not None and n_tokens > 1:
+            self.tok_per_s.record((n_tokens - 1) / max(t - ft, 1e-9))
+        self._instant("req.finish", req=int(req_id),
+                      tokens=int(n_tokens))
+
+    def drop(self, req_id: int) -> None:
+        """Forget a request without counting a finish (caller-side
+        cancellation paths)."""
+        self._marks.pop(req_id, None)
+
+    # -- gauges ----------------------------------------------------------
+    def record_occupancy(self, fraction: float) -> None:
+        self.page_occupancy.record(fraction)
+
+    def record_prefix_hit(self, ratio: float) -> None:
+        self.prefix_hit_ratio.record(ratio)
+
+    # -- readout ---------------------------------------------------------
+    def histograms(self) -> Dict[str, Histogram]:
+        return {
+            "queue_wait_s": self.queue_wait_s,
+            "ttft_s": self.ttft_s,
+            "tok_per_s": self.tok_per_s,
+            "prefix_hit_ratio": self.prefix_hit_ratio,
+            "page_occupancy": self.page_occupancy,
+        }
+
+    def summary(self) -> Dict[str, float]:
+        """Flat numeric p50/p95/p99/mean/count dict — the shape the
+        bench JSON lines and metrics rows consume."""
+        out: Dict[str, float] = {}
+        for name, hist in self.histograms().items():
+            out.update(hist.summary(name))
+        out["requests_finished"] = float(self.finished.value)
+        out["requests_preempted"] = float(self.preempted.value)
+        return out
+
+    def reset(self, keep_marks: bool = True) -> None:
+        """Drop accumulated histograms/counters (bench window resets).
+        In-flight request marks survive by default so a request
+        straddling the reset still finishes with sane latencies."""
+        self.queue_wait_s = Histogram()
+        self.ttft_s = Histogram()
+        self.tok_per_s = Histogram()
+        self.prefix_hit_ratio = Histogram()
+        self.page_occupancy = Histogram()
+        self.finished = Counter()
+        self.preempted = Counter()
+        if not keep_marks:
+            self._marks.clear()

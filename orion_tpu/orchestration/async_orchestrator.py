@@ -62,6 +62,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from orion_tpu import obs
 from orion_tpu.models.sharded import mesh_shardings_for
 from orion_tpu.parallel.mesh import make_mesh
 from orion_tpu.config import MeshConfig, ResilienceConfig
@@ -293,11 +294,12 @@ class AsyncOrchestrator:
         the pool's DCN fan-out)."""
 
         def _sync() -> None:
-            fault_point("weight_sync")
-            snapshot = jax.device_put(_compute_dtype_params(self),
-                                      self._rollout_shardings)
-            with self._weights_lock:
-                self._rollout_params = snapshot
+            with obs.span("weight_sync", version=self._version):
+                fault_point("weight_sync")
+                snapshot = jax.device_put(_compute_dtype_params(self),
+                                          self._rollout_shardings)
+                with self._weights_lock:
+                    self._rollout_params = snapshot
 
         if self.rcfg.weight_sync_attempts > 1:
             self.rcfg.retry_policy(
@@ -356,24 +358,28 @@ class AsyncOrchestrator:
                     return
                 self._rng, sub = jax.random.split(self._rng)
                 hb.beat()  # entering the long device dispatch
-                if hasattr(self.engine, "generate_batch"):
-                    # continuous engine: request-stream admission loop
-                    # behind the same batched contract.  Group trainers
-                    # pass the unique prompts + k so the engine can
-                    # share prompt pages across a group's clones (the
-                    # shared dispatch helper handles the split).
-                    from orion_tpu.trainers.base import \
-                        dispatch_generate_batch
+                with obs.span("rollout.generate", batch=i,
+                              version=version):
+                    if hasattr(self.engine, "generate_batch"):
+                        # continuous engine: request-stream admission
+                        # loop behind the same batched contract.
+                        # Group trainers pass the unique prompts + k
+                        # so the engine can share prompt pages across
+                        # a group's clones (the shared dispatch helper
+                        # handles the split).
+                        from orion_tpu.trainers.base import \
+                            dispatch_generate_batch
 
-                    result = dispatch_generate_batch(
-                        self.engine, np.asarray(ids), np.asarray(lens),
-                        sub, group_size=int(getattr(
-                            self.trainer.cfg, "group_size", 1)),
-                        params=params)
-                else:
-                    result = self.engine.generate(
-                        np.asarray(ids), np.asarray(lens), sub,
-                        params=params)
+                        result = dispatch_generate_batch(
+                            self.engine, np.asarray(ids),
+                            np.asarray(lens), sub,
+                            group_size=int(getattr(
+                                self.trainer.cfg, "group_size", 1)),
+                            params=params)
+                    else:
+                        result = self.engine.generate(
+                            np.asarray(ids), np.asarray(lens), sub,
+                            params=params)
                 # An incarnation abandoned (or shut down) while inside
                 # the dispatch drops its orphaned result here: scoring
                 # would race the replacement worker through the shared
@@ -426,6 +432,7 @@ class AsyncOrchestrator:
     # ------------------------------------------------------------------
     def _event(self, kind: str, detail) -> None:
         self.events.append((kind, detail))
+        obs.instant("orch." + kind, detail=repr(detail))
 
     def _worker_failure(self, worker: threading.Thread, hb: Heartbeat,
                         n_total: int) -> Optional[str]:
@@ -474,6 +481,12 @@ class AsyncOrchestrator:
         if self.recovery["rollout_restarts"] < self.rcfg.max_rollout_restarts:
             self.recovery["rollout_restarts"] += 1
             self._event("restart", self.recovery["rollout_restarts"])
+            obs.flight_dump("rollout-restart", {
+                "transition": "degradation-ladder: worker restart with "
+                              "fresh weight sync",
+                "failure": failure, "error": repr(err),
+                "restart": self.recovery["rollout_restarts"],
+                "budget": self.rcfg.max_rollout_restarts})
             _LOG.warning(
                 "rollout worker %s (%r); restart %d/%d with fresh "
                 "weight sync", failure, err,
@@ -486,6 +499,12 @@ class AsyncOrchestrator:
             return worker, stop, hb, False
         if self.rcfg.degrade_to_sync:
             self._event("degrade", self.recovery["rollout_restarts"])
+            obs.flight_dump("degrade", {
+                "transition": "degradation-ladder: restart budget "
+                              "exhausted, degrading to sync rollout on "
+                              "the train mesh",
+                "failure": failure, "error": repr(err),
+                "restarts": self.recovery["rollout_restarts"]})
             _LOG.error(
                 "rollout worker %s (%r) past the restart budget (%d); "
                 "degrading to synchronous rollout on the train mesh",
@@ -557,110 +576,137 @@ class AsyncOrchestrator:
                                                 wait=True)
                     break
                 prof.step(it)
-                t0 = time.perf_counter()
-                item = None
-                while item is None:
-                    if degraded:
-                        item = self._sync_rollout_item(prompt_iter)
-                        break
-                    failure = self._worker_failure(worker, hb, n)
-                    if failure is not None:
-                        worker, stop, hb, degraded = self._recover(
-                            failure, worker, stop, hb, prompt_iter, n,
-                            base0)
+                # Iteration timing rides obs spans (obs.timed measures
+                # even with tracing off): .duration/.elapsed laps
+                # replace the old naked perf_counter deltas (analysis
+                # rule `naked-timer`) AND put learner wait vs update on
+                # the Perfetto timeline next to the workers' spans.
+                with obs.timed("learner.iter", it=it) as sp_it:
+                    sp_wait = obs.timed("learner.wait")
+                    with sp_wait:
+                        item = None
+                        while item is None:
+                            if degraded:
+                                item = self._sync_rollout_item(prompt_iter)
+                                break
+                            failure = self._worker_failure(worker, hb, n)
+                            if failure is not None:
+                                worker, stop, hb, degraded = self._recover(
+                                    failure, worker, stop, hb, prompt_iter,
+                                    n, base0)
+                                continue
+                            try:
+                                item = self._queue.get(timeout=0.1)
+                            except queue.Empty:
+                                continue
+                    last_ds = item.data_state
+                    t_wait = sp_wait.duration
+                    # Quarantine gate: non-finite scores/logprobs are
+                    # never donated into the optimizer — the iteration
+                    # is spent (global_iter and version still advance
+                    # so the metrics step, the staleness gate, and the
+                    # producer/consumer batch count stay aligned) but
+                    # the update is skipped and the batch counted.  No
+                    # weight re-broadcast: with no update the published
+                    # snapshot is already current.
+                    quarantine = None
+                    if self.rcfg.quarantine_nonfinite:
+                        quarantine = self._quarantine_reason(item)
+                    if quarantine is not None:
+                        self.recovery["quarantined_batches"] += 1
+                        self._event("quarantine", it)
+                        _LOG.warning(
+                            "quarantined batch at iteration %d "
+                            "(non-finite %s): update skipped", it,
+                            quarantine)
+                        trainer.global_iter += 1
+                        with self._version_cv:
+                            self._version += 1
+                            self._version_cv.notify_all()
+                        stats = {
+                            "iteration": it, "quarantined": 1.0,
+                            "staleness": self._version - 1 - item.version,
+                        }
+                        stats.update(self._recovery_stats(degraded))
+                        trainer.metrics_history.append(stats)
+                        if trainer.writer is not None:
+                            trainer.writer.write(trainer.global_iter,
+                                                 stats)
+                        # A quarantine landing on an eval/checkpoint
+                        # boundary must not skip it — params HAVE
+                        # changed since the previous boundary (real
+                        # updates ran in between), and a later crash
+                        # would otherwise lose a full extra checkpoint
+                        # interval.
+                        if (eval_iter is not None and
+                                trainer.cfg.eval_every
+                                and trainer.global_iter
+                                % trainer.cfg.eval_every == 0):
+                            trainer.sync_weights()
+                            trainer._maybe_evaluate(eval_iter)
+                        if trainer.ckpt is not None and \
+                                trainer.global_iter \
+                                % trainer.cfg.checkpoint_every == 0:
+                            trainer.save_checkpoint(
+                                data_state=item.data_state,
+                                eval_iter=eval_iter)
                         continue
-                    try:
-                        item = self._queue.get(timeout=0.1)
-                    except queue.Empty:
-                        continue
-                last_ds = item.data_state
-                t_wait = time.perf_counter() - t0
-                # Quarantine gate: non-finite scores/logprobs are never
-                # donated into the optimizer — the iteration is spent
-                # (global_iter and version still advance so the metrics
-                # step, the staleness gate, and the producer/consumer
-                # batch count stay aligned) but the update is skipped
-                # and the batch counted.  No weight re-broadcast: with
-                # no update the published snapshot is already current.
-                quarantine = None
-                if self.rcfg.quarantine_nonfinite:
-                    quarantine = self._quarantine_reason(item)
-                if quarantine is not None:
-                    self.recovery["quarantined_batches"] += 1
-                    self._event("quarantine", it)
-                    _LOG.warning(
-                        "quarantined batch at iteration %d (non-finite "
-                        "%s): update skipped", it, quarantine)
+                    result = GenerationResult(**item.result_host)
+                    experience, exp_stats = trainer.build_experience(
+                        result, item.scores)
+                    upd_start = sp_it.elapsed()
+                    with obs.span("learner.update"):
+                        stats = trainer.update_epochs(experience)
                     trainer.global_iter += 1
+                    if not degraded:  # no consumer for the snapshot
+                        self._broadcast_weights()  # when the worker is gone
                     with self._version_cv:
                         self._version += 1
                         self._version_cv.notify_all()
-                    stats = {
-                        "iteration": it, "quarantined": 1.0,
+                    if (eval_iter is not None and trainer.cfg.eval_every
+                            and trainer.global_iter %
+                            trainer.cfg.eval_every == 0):
+                        # refresh the trainer-side engine first: in
+                        # async mode nothing else calls sync_weights,
+                        # and the update step donates the old param
+                        # buffers.
+                        trainer.sync_weights()
+                        trainer._maybe_evaluate(eval_iter)
+                    t_done = sp_it.elapsed()
+                    stats.update(exp_stats)
+                    n_samples = int(
+                        item.result_host["prompt_lens"].shape[0])
+                    stats.update({
+                        "iteration": it,
                         "staleness": self._version - 1 - item.version,
-                    }
+                        "time_learner_wait_s": t_wait,
+                        "time_update_s": t_done - upd_start,
+                        "samples_per_sec": n_samples / max(t_done, 1e-9),
+                    })
                     stats.update(self._recovery_stats(degraded))
                     trainer.metrics_history.append(stats)
                     if trainer.writer is not None:
                         trainer.writer.write(trainer.global_iter, stats)
-                    # A quarantine landing on an eval/checkpoint
-                    # boundary must not skip it — params HAVE changed
-                    # since the previous boundary (real updates ran in
-                    # between), and a later crash would otherwise lose
-                    # a full extra checkpoint interval.
-                    if (eval_iter is not None and trainer.cfg.eval_every
-                            and trainer.global_iter
-                            % trainer.cfg.eval_every == 0):
-                        trainer.sync_weights()
-                        trainer._maybe_evaluate(eval_iter)
-                    if trainer.ckpt is not None and trainer.global_iter \
+                    if trainer.cfg.log_every and \
+                            it % trainer.cfg.log_every == 0:
+                        trainer.log(stats)
+                    if trainer.ckpt is not None and \
+                            trainer.global_iter \
                             % trainer.cfg.checkpoint_every == 0:
-                        trainer.save_checkpoint(data_state=item.data_state,
-                                                eval_iter=eval_iter)
-                    continue
-                result = GenerationResult(**item.result_host)
-                experience, exp_stats = trainer.build_experience(
-                    result, item.scores)
-                t1 = time.perf_counter()
-                stats = trainer.update_epochs(experience)
-                trainer.global_iter += 1
-                if not degraded:  # no consumer for the snapshot when
-                    self._broadcast_weights()  # the worker is gone
-                with self._version_cv:
-                    self._version += 1
-                    self._version_cv.notify_all()
-                if (eval_iter is not None and trainer.cfg.eval_every and
-                        trainer.global_iter %
-                        trainer.cfg.eval_every == 0):
-                    # refresh the trainer-side engine first: in async
-                    # mode nothing else calls sync_weights, and the
-                    # update step donates the old param buffers.
-                    trainer.sync_weights()
-                    trainer._maybe_evaluate(eval_iter)
-                t2 = time.perf_counter()
-                stats.update(exp_stats)
-                n_samples = int(item.result_host["prompt_lens"].shape[0])
-                stats.update({
-                    "iteration": it,
-                    "staleness": self._version - 1 - item.version,
-                    "time_learner_wait_s": t_wait,
-                    "time_update_s": t2 - t1,
-                    "samples_per_sec": n_samples / (t2 - t0),
-                })
-                stats.update(self._recovery_stats(degraded))
-                trainer.metrics_history.append(stats)
-                if trainer.writer is not None:
-                    trainer.writer.write(trainer.global_iter, stats)
-                if trainer.cfg.log_every and it % trainer.cfg.log_every == 0:
-                    trainer.log(stats)
-                if trainer.ckpt is not None and \
-                        trainer.global_iter % trainer.cfg.checkpoint_every == 0:
-                    # The saved cursor is the rollout thread's snapshot
-                    # for the batch being trained — it lags the live
-                    # iterator by at most `staleness` batches, so a
-                    # resume replays only freshly-generated experience.
-                    trainer.save_checkpoint(data_state=item.data_state,
-                                            eval_iter=eval_iter)
+                        # The saved cursor is the rollout thread's
+                        # snapshot for the batch being trained — it
+                        # lags the live iterator by at most
+                        # `staleness` batches, so a resume replays
+                        # only freshly-generated experience.
+                        trainer.save_checkpoint(
+                            data_state=item.data_state,
+                            eval_iter=eval_iter)
+        except BaseException as e:
+            # Forensics before the crash surfaces: the flight recorder
+            # (if armed) captures what every thread was doing.
+            obs.flight_dump("unhandled-exception",
+                            {"error": repr(e), "loop": "async"})
+            raise
         finally:
             prof.stop()
             stop.set()
@@ -677,6 +723,13 @@ class AsyncOrchestrator:
                     raise RuntimeError(
                         "rollout worker thread leaked: still alive "
                         "after stop + 30s join")
+        if prof.traced and trainer.metrics_history:
+            # Surface the trace artifact in the final row (same
+            # contract as BaseTrainer.train).
+            trainer.metrics_history[-1]["profile_dir"] = prof.dir
+        # The ROLLOUT GROUP's engine did the serving — its telemetry,
+        # not the trainer's sync-path engine's, is the summary row.
+        trainer._write_serving_stats(self.engine)
         if trainer.ckpt is not None:
             trainer.ckpt.wait()
         if self._rollout_error is not None and not preempted:
@@ -768,6 +821,7 @@ class PoolOrchestrator:
 
     def _event(self, kind: str, detail) -> None:
         self.events.append((kind, detail))
+        obs.instant("orch." + kind, detail=repr(detail))
 
     # ------------------------------------------------------------------
     # weight fan-out (learner → every pool worker, host-staged)
@@ -782,19 +836,20 @@ class PoolOrchestrator:
         return host_tree(_compute_dtype_params(self))
 
     def _broadcast(self) -> None:
-        if self.rcfg.weight_sync_attempts > 1:
-            snap = self.rcfg.retry_policy(
-                self.rcfg.weight_sync_attempts,
-                seed=self.trainer.cfg.seed).call(
-                    self._host_snapshot,
-                    on_retry=lambda a, e, d: self._event(
-                        "weight_sync_retry", a))
-        else:
-            snap = self._host_snapshot()
-        # Per-worker send failures are the POOL's problem (a failed
-        # send marks that worker dead); the broadcast itself never
-        # takes the learner down.
-        self.pool.broadcast(snap, self._version)
+        with obs.span("weight_sync", version=self._version):
+            if self.rcfg.weight_sync_attempts > 1:
+                snap = self.rcfg.retry_policy(
+                    self.rcfg.weight_sync_attempts,
+                    seed=self.trainer.cfg.seed).call(
+                        self._host_snapshot,
+                        on_retry=lambda a, e, d: self._event(
+                            "weight_sync_retry", a))
+            else:
+                snap = self._host_snapshot()
+            # Per-worker send failures are the POOL's problem (a
+            # failed send marks that worker dead); the broadcast
+            # itself never takes the learner down.
+            self.pool.broadcast(snap, self._version)
 
     # ------------------------------------------------------------------
     # supervised acquisition
@@ -810,6 +865,12 @@ class PoolOrchestrator:
             if got is not None:
                 member, frame = got
                 payload = frame["item"]
+                # Cross-process causality: the consume event names the
+                # worker's rollout.generate span (it rode the TRAJ
+                # frame header) as its parent.
+                obs.instant("learner.consume", worker=member.wid,
+                            seq=int(frame.get("seq", -1)),
+                            parent=int(frame.get("_obs_parent", 0)))
                 return member.wid, _Item(
                     payload["result"],
                     np.asarray(payload["scores"], np.float32),
@@ -836,6 +897,13 @@ class PoolOrchestrator:
                 continue
             if self.rcfg.degrade_to_sync and prompt_iter is not None:
                 self._event("degrade", it)
+                obs.flight_dump("degrade", {
+                    "transition": "degradation-ladder: pool empty past "
+                                  "rejoin grace, degrading to sync "
+                                  "rollout on the train mesh",
+                    "iteration": it,
+                    "rejoin_grace": self.rcfg.rejoin_grace,
+                    "pool_recovery": dict(self.pool.recovery)})
                 _LOG.error(
                     "worker pool still empty past the %.1fs rejoin "
                     "grace; degrading to synchronous rollout on the "
@@ -882,104 +950,122 @@ class PoolOrchestrator:
                     self._event("preempt", it)
                     break
                 prof.step(it)
-                t0 = time.perf_counter()
-                if degraded:
-                    wid, item = -1, _sync_rollout_item(self, prompt_iter)
-                else:
-                    got = self._next_item(it, prompt_iter)
-                    if got is None:
-                        if preemption_requested():
-                            preempted = True
-                            self._event("preempt", it)
-                            break
-                        degraded = True
-                        wid, item = -1, _sync_rollout_item(self,
-                                                           prompt_iter)
-                    else:
-                        wid, item = got
-                last_ds = item.data_state
-                t_wait = time.perf_counter() - t0
-                quarantine = None
-                if self.rcfg.quarantine_nonfinite:
-                    quarantine = _quarantine_reason(item)
-                if quarantine is not None:
-                    self.recovery["quarantined_batches"] += 1
-                    self._event("quarantine", it)
-                    _LOG.warning(
-                        "quarantined pool batch at iteration %d "
-                        "(non-finite %s, worker %d): update skipped",
-                        it, quarantine, wid)
+                # Same span scheme as AsyncOrchestrator.train: wait vs
+                # update as spans (durations feed the metrics row even
+                # with tracing off; with it, the learner's timeline
+                # merges with the workers' under one trace id).
+                with obs.timed("learner.iter", it=it) as sp_it:
+                    sp_wait = obs.timed("learner.wait")
+                    with sp_wait:
+                        if degraded:
+                            wid, item = -1, _sync_rollout_item(
+                                self, prompt_iter)
+                        else:
+                            got = self._next_item(it, prompt_iter)
+                            if got is None:
+                                if preemption_requested():
+                                    preempted = True
+                                    self._event("preempt", it)
+                                    break
+                                degraded = True
+                                wid, item = -1, _sync_rollout_item(
+                                    self, prompt_iter)
+                            else:
+                                wid, item = got
+                    last_ds = item.data_state
+                    t_wait = sp_wait.duration
+                    quarantine = None
+                    if self.rcfg.quarantine_nonfinite:
+                        quarantine = _quarantine_reason(item)
+                    if quarantine is not None:
+                        self.recovery["quarantined_batches"] += 1
+                        self._event("quarantine", it)
+                        _LOG.warning(
+                            "quarantined pool batch at iteration %d "
+                            "(non-finite %s, worker %d): update skipped",
+                            it, quarantine, wid)
+                        trainer.global_iter += 1
+                        self._version += 1
+                        if not degraded:
+                            # Unlike the in-process path, the advanced
+                            # version tag must still REACH the workers
+                            # — they stamp future TRAJ frames with the
+                            # last received version, so skipping it
+                            # would skew every later staleness metric
+                            # by one.  The params changed by NOT ONE
+                            # BYTE (the update was skipped), so only
+                            # the tag ships — never the multi-GB
+                            # snapshot.
+                            self.pool.broadcast_version(self._version)
+                        stats = {
+                            "iteration": it, "quarantined": 1.0,
+                            "worker": float(wid),
+                            "staleness": self._version - 1 - item.version,
+                        }
+                        stats.update(self._recovery_stats(degraded))
+                        trainer.metrics_history.append(stats)
+                        if trainer.writer is not None:
+                            trainer.writer.write(trainer.global_iter,
+                                                 stats)
+                        # Same boundary contract as the in-process
+                        # path: a quarantine landing on an
+                        # eval/checkpoint boundary must not skip it.
+                        if (eval_iter is not None and
+                                trainer.cfg.eval_every
+                                and trainer.global_iter
+                                % trainer.cfg.eval_every == 0):
+                            trainer.sync_weights()
+                            trainer._maybe_evaluate(eval_iter)
+                        if trainer.ckpt is not None and \
+                                trainer.global_iter \
+                                % trainer.cfg.checkpoint_every == 0:
+                            trainer.save_checkpoint(
+                                data_state=item.data_state,
+                                eval_iter=eval_iter)
+                        continue
+                    result = GenerationResult(**item.result_host)
+                    experience, exp_stats = trainer.build_experience(
+                        result, item.scores)
+                    upd_start = sp_it.elapsed()
+                    with obs.span("learner.update"):
+                        stats = trainer.update_epochs(experience)
                     trainer.global_iter += 1
                     self._version += 1
                     if not degraded:
-                        # Unlike the in-process path, the advanced
-                        # version tag must still REACH the workers —
-                        # they stamp future TRAJ frames with the last
-                        # received version, so skipping it would skew
-                        # every later staleness metric by one.  The
-                        # params changed by NOT ONE BYTE (the update
-                        # was skipped), so only the tag ships — never
-                        # the multi-GB snapshot.
-                        self.pool.broadcast_version(self._version)
-                    stats = {
-                        "iteration": it, "quarantined": 1.0,
+                        self._broadcast()
+                    if (eval_iter is not None and trainer.cfg.eval_every
+                            and trainer.global_iter %
+                            trainer.cfg.eval_every == 0):
+                        trainer.sync_weights()
+                        trainer._maybe_evaluate(eval_iter)
+                    t_done = sp_it.elapsed()
+                    stats.update(exp_stats)
+                    n_samples = int(
+                        item.result_host["prompt_lens"].shape[0])
+                    stats.update({
+                        "iteration": it,
                         "worker": float(wid),
                         "staleness": self._version - 1 - item.version,
-                    }
+                        "time_learner_wait_s": t_wait,
+                        "time_update_s": t_done - upd_start,
+                        "samples_per_sec": n_samples / max(t_done, 1e-9),
+                    })
                     stats.update(self._recovery_stats(degraded))
                     trainer.metrics_history.append(stats)
                     if trainer.writer is not None:
                         trainer.writer.write(trainer.global_iter, stats)
-                    # Same boundary contract as the in-process path: a
-                    # quarantine landing on an eval/checkpoint boundary
-                    # must not skip it.
-                    if (eval_iter is not None and trainer.cfg.eval_every
-                            and trainer.global_iter
-                            % trainer.cfg.eval_every == 0):
-                        trainer.sync_weights()
-                        trainer._maybe_evaluate(eval_iter)
-                    if trainer.ckpt is not None and trainer.global_iter \
+                    if trainer.cfg.log_every and \
+                            it % trainer.cfg.log_every == 0:
+                        trainer.log(stats)
+                    if trainer.ckpt is not None and \
+                            trainer.global_iter \
                             % trainer.cfg.checkpoint_every == 0:
-                        trainer.save_checkpoint(data_state=item.data_state,
-                                                eval_iter=eval_iter)
-                    continue
-                result = GenerationResult(**item.result_host)
-                experience, exp_stats = trainer.build_experience(
-                    result, item.scores)
-                t1 = time.perf_counter()
-                stats = trainer.update_epochs(experience)
-                trainer.global_iter += 1
-                self._version += 1
-                if not degraded:
-                    self._broadcast()
-                if (eval_iter is not None and trainer.cfg.eval_every and
-                        trainer.global_iter %
-                        trainer.cfg.eval_every == 0):
-                    trainer.sync_weights()
-                    trainer._maybe_evaluate(eval_iter)
-                t2 = time.perf_counter()
-                stats.update(exp_stats)
-                n_samples = int(item.result_host["prompt_lens"].shape[0])
-                stats.update({
-                    "iteration": it,
-                    "worker": float(wid),
-                    "staleness": self._version - 1 - item.version,
-                    "time_learner_wait_s": t_wait,
-                    "time_update_s": t2 - t1,
-                    "samples_per_sec": n_samples / (t2 - t0),
-                })
-                stats.update(self._recovery_stats(degraded))
-                trainer.metrics_history.append(stats)
-                if trainer.writer is not None:
-                    trainer.writer.write(trainer.global_iter, stats)
-                if trainer.cfg.log_every and \
-                        it % trainer.cfg.log_every == 0:
-                    trainer.log(stats)
-                if trainer.ckpt is not None and trainer.global_iter \
-                        % trainer.cfg.checkpoint_every == 0:
-                    trainer.save_checkpoint(data_state=item.data_state,
-                                            eval_iter=eval_iter)
-        except BaseException:
+                        trainer.save_checkpoint(
+                            data_state=item.data_state,
+                            eval_iter=eval_iter)
+        except BaseException as e:
+            obs.flight_dump("unhandled-exception",
+                            {"error": repr(e), "loop": "pool"})
             # An exception escaping train() (empty pool with
             # degrade_to_sync off, a quorum timeout, an update or
             # checkpoint failure) must still release a config-built
@@ -992,6 +1078,8 @@ class PoolOrchestrator:
             raise
         finally:
             prof.stop()
+        if prof.traced and trainer.metrics_history:
+            trainer.metrics_history[-1]["profile_dir"] = prof.dir
         if preempted:
             self._preempt_shutdown(eval_iter, last_ds)
         elif self._own_pool:

@@ -22,9 +22,15 @@ test_two_process_async_decoupled`` runs the 1×1 pattern on two real
 processes; ``tests/test_worker_pool.py`` runs the N-worker pool.
 
 Wire format: a fixed header — magic bytes, protocol version, frame
-kind — then a length-prefixed pickle of a numpy pytree.  A stray or
-version-skewed peer fails the handshake with a clear
-:class:`ProtocolError` instead of an opaque pickle exception mid-run.
+kind, the sender's (trace id, span id) — then a length-prefixed
+pickle of a numpy pytree.  A stray or version-skewed peer fails the
+handshake with a clear :class:`ProtocolError` instead of an opaque
+pickle exception mid-run.  The trace ids are the distributed-tracing
+hook (orion_tpu.obs): the HELLO ack carries the learner's trace id,
+every worker adopts it, and TRAJ frames name the worker's generate
+span — so one trace stitches submit → worker-generate → TRAJ →
+consume → update across the whole pool, and per-process Chrome dumps
+merge into a single Perfetto timeline.
 Pickle is safe here: both endpoints are processes of the same training
 job on a private port, which is the same trust domain as the
 checkpoint files they already exchange.
@@ -65,6 +71,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 import jax
 import numpy as np
 
+from orion_tpu import obs
 from orion_tpu.resilience import Watchdog, fault_point
 
 _LOG = logging.getLogger(__name__)
@@ -81,10 +88,16 @@ _LEN = struct.Struct(">Q")
 #: lengths into the pickle loader.
 MAGIC = b"ORTP"
 #: Bumped on any wire-format change; both ends must match exactly.
-PROTOCOL_VERSION = 3
+#: v4: the header grew trace/span ids (distributed tracing — one
+#: trace id stitches learner + every worker into a single Perfetto
+#: timeline); a v3 peer is rejected cleanly by the version check.
+PROTOCOL_VERSION = 4
 
-#: magic(4) + version(u16) + kind(u8) + payload length(u64)
-_HEADER = struct.Struct(">4sHBQ")
+#: magic(4) + version(u16) + kind(u8) + trace id(u64) + originating
+#: span id(u64) + payload length(u64).  The trace/span ids are 0 when
+#: the sender's tracer is disabled — tracing changes no wire SIZE,
+#: only two header fields.
+_HEADER = struct.Struct(">4sHBQQQ")
 
 # Frame kinds multiplexed on one channel.
 FRAME_DATA = 0       # legacy send()/recv() payload
@@ -155,14 +168,29 @@ class PyTreeChannel:
     :class:`TimeoutError` instead of hanging the learner on a silently
     dead peer.  Sends are serialized by an internal lock so a
     heartbeat thread and a trajectory sender can share the channel.
+
+    Tracing: every frame header carries the sender's
+    (trace id, current span id) — ``tracer`` defaults to the process
+    tracer (``orion_tpu.obs``); tests standing in for several
+    processes inside one interpreter pass per-endpoint instances.
+    After a ``recv_frame``, ``last_remote_ctx`` holds the peer's ids
+    (the worker adopts the learner's trace id from it; the learner
+    links consume events to the worker's generate span).
     """
 
-    def __init__(self, sock: socket.socket, recv_deadline: float = 0.0):
+    def __init__(self, sock: socket.socket, recv_deadline: float = 0.0,
+                 tracer=None):
         self._sock = sock
         _harden_socket(sock)
         self._send_lock = threading.Lock()
+        self._tracer = tracer
+        self.last_remote_ctx: Tuple[int, int] = (0, 0)
         sock.settimeout(None)  # blocking; deadlines are kernel-level
         self.set_recv_deadline(recv_deadline)
+
+    def _trc(self):
+        return self._tracer if self._tracer is not None else \
+            obs.get_tracer()
 
     def set_recv_deadline(self, deadline: float) -> None:
         """Apply the idle-receive deadline via SO_RCVTIMEO — kernel-
@@ -186,7 +214,7 @@ class PyTreeChannel:
     @classmethod
     def listen(cls, port: int, host: str = "localhost",
                timeout: float = 120.0,
-               recv_deadline: float = 0.0) -> "PyTreeChannel":
+               recv_deadline: float = 0.0, tracer=None) -> "PyTreeChannel":
         """Accept exactly one peer (the 1×1 split; the pool uses
         :class:`WorkerPool` instead)."""
         srv = socket.socket()
@@ -198,13 +226,14 @@ class PyTreeChannel:
             conn, _ = srv.accept()
         finally:
             srv.close()
-        return cls(conn, recv_deadline=recv_deadline)
+        return cls(conn, recv_deadline=recv_deadline, tracer=tracer)
 
     @classmethod
     def connect(cls, port: int, host: str = "localhost",
                 timeout: float = 120.0,
                 seed: Optional[int] = None,
-                recv_deadline: float = 0.0) -> "PyTreeChannel":
+                recv_deadline: float = 0.0,
+                tracer=None) -> "PyTreeChannel":
         """Connect to the listening peer, retrying until it is up.
 
         Jittered exponential backoff: a fixed retry cadence from every
@@ -229,7 +258,8 @@ class PyTreeChannel:
                 # learner can legitimately spend minutes inside one
                 # compile) takes over from here, with SO_KEEPALIVE
                 # guarding the silent-peer-death case either way.
-                return cls(sock, recv_deadline=recv_deadline)
+                return cls(sock, recv_deadline=recv_deadline,
+                           tracer=tracer)
             except OSError as e:
                 last = e
                 remaining = deadline - time.monotonic()
@@ -254,16 +284,21 @@ class PyTreeChannel:
         worker would cost N full serializations of the same tree on
         the learner's critical path."""
         fault_point("remote.channel")
+        tr = self._trc()
+        tid, sid = tr.context()  # (0, 0) when tracing is off
         # Header and payload go out separately: concatenating would
         # materialize a second full copy of a multi-GB weight snapshot.
         with self._send_lock:
             self._sock.sendall(_HEADER.pack(MAGIC, PROTOCOL_VERSION,
-                                            kind, len(payload)))
+                                            kind, tid, sid, len(payload)))
             self._sock.sendall(payload)
+        if tr.enabled:
+            tr.instant("ortp.send." + _FRAME_NAMES.get(kind, str(kind)),
+                       bytes=len(payload))
 
     def recv_frame(self) -> Tuple[int, Any]:
         fault_point("remote.channel")
-        magic, version, kind, n = _HEADER.unpack(
+        magic, version, kind, r_tid, r_sid, n = _HEADER.unpack(
             self._recv_exact(_HEADER.size))
         if magic != MAGIC:
             raise ProtocolError(
@@ -292,6 +327,14 @@ class PyTreeChannel:
                 raise ConnectionError(
                     "pytree channel peer closed mid-message")
             got += r
+        # The peer's tracing context: the caller decides what to do
+        # with it (workers ADOPT the learner's trace id; the learner
+        # links consume events to the worker's generate span).
+        self.last_remote_ctx = (r_tid, r_sid)
+        tr = self._trc()
+        if tr.enabled:
+            tr.instant("ortp.recv." + _FRAME_NAMES.get(kind, str(kind)),
+                       parent=r_sid, bytes=n)
         return kind, pickle.loads(view)
 
     # -- legacy unframed API (kind DATA) --------------------------------
@@ -379,8 +422,12 @@ class WorkerPool:
                  rejoin_budget: int = 4,
                  recv_deadline: float = 0.0,
                  accept_timeout: float = 0.5,
-                 staleness: Optional[int] = None):
+                 staleness: Optional[int] = None,
+                 tracer=None):
         self.host = host
+        #: Learner-side tracer for every member channel (None = the
+        #: process tracer); membership events mirror into it.
+        self._tracer = tracer
         self.heartbeat_timeout = heartbeat_timeout
         self.rejoin_budget = rejoin_budget
         self.recv_deadline = recv_deadline
@@ -420,7 +467,7 @@ class WorkerPool:
 
     @classmethod
     def from_config(cls, rcfg, port: int = 0,
-                    host: str = "localhost") -> "WorkerPool":
+                    host: str = "localhost", tracer=None) -> "WorkerPool":
         """Construct the learner-side pool from
         ``TrainConfig.resilience`` — the knobs documented there
         (`heartbeat_timeout`, `rejoin_budget`,
@@ -429,12 +476,20 @@ class WorkerPool:
         return cls(port, host=host,
                    heartbeat_timeout=rcfg.heartbeat_timeout,
                    rejoin_budget=rcfg.rejoin_budget,
-                   recv_deadline=rcfg.channel_recv_deadline)
+                   recv_deadline=rcfg.channel_recv_deadline,
+                   tracer=tracer)
 
     # -- membership ----------------------------------------------------
+    def _trc(self):
+        return self._tracer if self._tracer is not None else \
+            obs.get_tracer()
+
     def _event(self, kind: str, detail) -> None:
         with self._lock:
             self.events.append((kind, detail))
+        tr = self._trc()
+        if tr.enabled:
+            tr.instant("pool." + kind, detail=repr(detail))
 
     def live_members(self) -> List[PoolMember]:
         with self._lock:
@@ -507,7 +562,8 @@ class WorkerPool:
 
     def _admit(self, conn: socket.socket, addr) -> None:
         chan = PyTreeChannel(conn, recv_deadline=max(
-            self.recv_deadline, 10.0) if self.recv_deadline else 10.0)
+            self.recv_deadline, 10.0) if self.recv_deadline else 10.0,
+            tracer=self._tracer)
         # The handshake itself is deadlined: a peer that connects and
         # goes silent must not wedge the accept loop.
         kind, hello = chan.recv_frame()
@@ -615,6 +671,12 @@ class WorkerPool:
                     member.hb.beat()
                 elif kind == FRAME_TRAJ:
                     member.hb.beat()  # a trajectory is the best heartbeat
+                    if self._trc().enabled:
+                        # The worker's generate-span id (same thread
+                        # just parsed this frame's header): the
+                        # learner's consume event links to it.
+                        payload["_obs_parent"] = \
+                            member.chan.last_remote_ctx[1]
                     # Gated under the pool lock against _mark_dead: a
                     # frame landing after another thread declared this
                     # worker dead (e.g. a failed broadcast send) must
@@ -681,6 +743,14 @@ class WorkerPool:
         _LOG.error("worker wid=%d dead (%s); %d in-flight batches "
                    "discarded; %d workers remain", member.wid, reason,
                    discarded, len(self.live_members()))
+        # Forensics: the moment the ladder's first rung fires is
+        # exactly when the recent timeline matters — dump it (no-op
+        # without an installed recorder, never raises).
+        obs.flight_dump("worker-death", {
+            "transition": "degradation-ladder: worker marked dead, "
+                          "survivors absorb the load",
+            "wid": member.wid, "name": member.name, "reason": reason,
+            "discarded": discarded, "recovery": dict(self.recovery)})
         try:
             member.chan.close()
         except OSError:
@@ -882,9 +952,11 @@ class PoolWorkerClient:
                  heartbeat_interval: float = 0.5,
                  connect_timeout: float = 120.0,
                  seed: Optional[int] = None,
-                 recv_deadline: float = 0.0):
+                 recv_deadline: float = 0.0,
+                 tracer=None):
         self.name = name or f"worker-{os.getpid()}"
         self.heartbeat_interval = heartbeat_interval
+        self._tracer = tracer
         self.watchdog = Watchdog()
         self._lock = threading.Lock()
         self._weights_cv = threading.Condition(self._lock)
@@ -897,7 +969,7 @@ class PoolWorkerClient:
         fault_point("worker.hello")
         self.chan = PyTreeChannel.connect(
             port, host=host, timeout=connect_timeout, seed=seed,
-            recv_deadline=recv_deadline)
+            recv_deadline=recv_deadline, tracer=tracer)
         self.chan.send_frame(FRAME_HELLO,
                              {"name": self.name, "pid": os.getpid(),
                               "protocol": PROTOCOL_VERSION})
@@ -921,6 +993,11 @@ class PoolWorkerClient:
         if "params" in ack:
             self._version = int(ack["version"])
             self._params = ack["params"]
+        # Distributed tracing: the HELLO ack's header carries the
+        # LEARNER's trace id — adopt it so every span this worker
+        # records stitches into the learner's trace (one trace id
+        # across the whole pool).
+        self._trc().adopt_trace(self.chan.last_remote_ctx[0])
         # Both client threads run under the client's own watchdog —
         # the run loop is their supervisor (lint: unsupervised-thread).
         hb_beat = self.watchdog.register(f"hb-send-{self.wid}", timeout=0.0)
@@ -937,15 +1014,22 @@ class PoolWorkerClient:
     @classmethod
     def from_config(cls, rcfg, port: int, host: str = "localhost",
                     name: Optional[str] = None,
-                    seed: Optional[int] = None) -> "PoolWorkerClient":
+                    seed: Optional[int] = None,
+                    tracer=None) -> "PoolWorkerClient":
         """Construct the worker-side client from
         ``TrainConfig.resilience`` (`heartbeat_interval`,
         `channel_recv_deadline`) — every worker process of a job
-        built from the same config speaks the same cadence."""
+        built from the same config speaks the same cadence.
+        ``tracer`` (tests standing in for processes) defaults to the
+        process tracer."""
         return cls(port, host=host, name=name,
                    heartbeat_interval=rcfg.heartbeat_interval,
                    recv_deadline=rcfg.channel_recv_deadline,
-                   seed=seed)
+                   seed=seed, tracer=tracer)
+
+    def _trc(self):
+        return self._tracer if self._tracer is not None else \
+            obs.get_tracer()
 
     # -- background threads ---------------------------------------------
     def _heartbeat_loop(self, beat) -> None:
@@ -973,6 +1057,10 @@ class PoolWorkerClient:
                 beat.beat()
                 kind, payload = self.chan.recv_frame()
                 if kind == FRAME_WEIGHTS:
+                    # Keep the trace id fresh: a worker admitted
+                    # before the learner enabled tracing adopts on
+                    # the first traced WEIGHTS frame instead.
+                    self._trc().adopt_trace(self.chan.last_remote_ctx[0])
                     with self._weights_cv:
                         # Latest-wins: a slow worker skips straight to
                         # the freshest snapshot instead of replaying
@@ -1116,10 +1204,16 @@ class PoolWorkerClient:
                 version, params = self.wait_weights(0)
                 if self.goodbye.is_set() or self.closed.is_set():
                     break
-                in_gen = True
-                payload = generate_fn(i, version, params)
-                in_gen = False
-                self.send_traj(payload, version)
+                # The span covers generate AND the TRAJ send, so the
+                # frame header carries this span's id — the learner's
+                # consume event names it as its parent (cross-process
+                # causality).  No-op when tracing is off.
+                with self._trc().span("rollout.generate", batch=i,
+                                      version=version, wid=self.wid):
+                    in_gen = True
+                    payload = generate_fn(i, version, params)
+                    in_gen = False
+                    self.send_traj(payload, version)
                 i += 1
         except (ConnectionError, TimeoutError, OSError):
             self.close()

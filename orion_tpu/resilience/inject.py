@@ -130,6 +130,13 @@ class FaultPlan:
             spec = self._specs.get(point)
             if spec is not None and spec.should_fire(hit):
                 self.events.append((point, hit))
+                # Forensic marker on the trace timeline (no-op unless
+                # tracing is armed): the flight-recorder dump a fault
+                # triggers shows exactly WHICH injection fired.  Lazy
+                # import — resilience must stay importable before obs.
+                from orion_tpu.obs import instant
+
+                instant("fault." + point, hit=hit)
                 raise InjectedFault(point, hit)
 
 

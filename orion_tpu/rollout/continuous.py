@@ -54,7 +54,9 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from orion_tpu import obs
 from orion_tpu.config import ModelConfig, RolloutConfig
+from orion_tpu.obs import RequestTelemetry
 from orion_tpu.ops.sampling import (eos_forbid_mask, is_stop_token,
                                     sample_tokens, seen_from_prompts)
 from orion_tpu.runtime import Scheduler
@@ -220,6 +222,12 @@ class ContinuousBatchingEngine:
         self._rng = None
         self.preemptions = 0         # recompute-restarts (metrics)
         self.prefix_cached_pages = 0  # prompt pages served from cache
+        # Request-lifecycle telemetry (orion_tpu.obs): submit/admit/
+        # first-token/preempt/finish clocks + queue-wait/TTFT/tok-s/
+        # occupancy histograms.  Host-dict cost per REQUEST transition,
+        # not per token; the tracing instants inside are no-ops unless
+        # the process tracer is enabled.
+        self.telemetry = RequestTelemetry()
         if cfg.harvest_lag >= 0:
             self._harvest_lag = cfg.harvest_lag
         else:
@@ -591,6 +599,8 @@ class ContinuousBatchingEngine:
                            deadline=dl, prefix_hashes=hashes)
         for j in range(k):
             self._reqinfo[req_id + j] = (ids, budget, req_id, j, k)
+            self.telemetry.mark(req_id + j, "submit",
+                                prompt_len=len(ids), budget=budget)
 
     @property
     def pending(self) -> int:
@@ -617,6 +627,7 @@ class ContinuousBatchingEngine:
         self._bt[slot, :] = self._scratch
         self._bt_dev = None
         self.preemptions += 1
+        self.telemetry.preempt(rid)
 
     def _extend_running(self) -> None:
         """Grow every decoding slot's reservation to cover the next
@@ -732,6 +743,12 @@ class ContinuousBatchingEngine:
                 jnp.asarray(copy_dst), self._state, rng,
                 do_copy=has_groups)
         self._pools, self._state = pools, state
+        for e in entries.values():
+            for rid, _slot in e["slots"].values():
+                # The final chunk just sampled this request's first
+                # token (dispatch time — TTFT measured to the host-loop
+                # boundary, consistent with queue wait).
+                self.telemetry.mark(rid, "first_token")
 
     def _prefill_wave(self, rng) -> None:
         """Advance every mid-prefill prompt by one chunk: rows whose
@@ -779,6 +796,13 @@ class ContinuousBatchingEngine:
             raise ValueError("no sampling stream: call reset_rng() first")
         if self._state is None:
             self._state = self._init_state()
+        # One span per wave (no-op when tracing is off): the serving
+        # timeline's unit of work, nesting the prefill/segment
+        # dispatches and the req.* lifecycle instants.
+        with obs.span("engine.step", pending=len(self._reqinfo)):
+            return self._step_wave()
+
+    def _step_wave(self) -> List[CompletedRequest]:
         self._early_out = []
 
         # -- admission (between jitted segments) ------------------------
@@ -796,9 +820,15 @@ class ContinuousBatchingEngine:
             self._phase[slot] = _PREFILL
             self._admit_seq[rid] = self._admit_counter
             self._admit_counter += 1
+            self.telemetry.mark(rid, "admit", slot=slot)
             if j == 0:
                 cached = self.sched.cached_count(rid)
                 self.prefix_cached_pages += cached
+                # Prefix-cache hit fraction over the CACHEABLE pages
+                # (full prompt pages, capped so >=1 token re-forwards).
+                cacheable = max(0, (len(ids) - 1) // self.cfg.page_size)
+                if cacheable > 0 and self._prefix_cache_on:
+                    self.telemetry.record_prefix_hit(cached / cacheable)
                 e = self._prefilling.setdefault(
                     head, {"ids": ids, "budget": budget, "k": k,
                            "off": cached * self.cfg.page_size,
@@ -814,6 +844,10 @@ class ContinuousBatchingEngine:
 
         # -- on-demand reservation growth (may preempt) -----------------
         self._extend_running()
+        # Page-pool occupancy at the wave's peak (post-extension):
+        # the headroom signal behind watermark/preemption tuning.
+        self.telemetry.record_occupancy(
+            1.0 - self.sched.available_pages / max(self.num_pages, 1))
 
         # -- decode segment (fixed length: done slots idle in place,
         #    so no reservation-overrun risk) ----------------------------
@@ -900,6 +934,7 @@ class ContinuousBatchingEngine:
                     policy_logprobs=rows_h["p"][s][:n].astype(
                         np.float32)))
                 self.sched.finish(rid)
+                self.telemetry.finish(rid, n)
                 del self._reqinfo[rid]
                 self._admit_seq.pop(rid, None)
                 self._slot_req[s] = -1
@@ -908,6 +943,26 @@ class ContinuousBatchingEngine:
                 self._bt[s, :] = self._scratch  # free pages
                 self._bt_dev = None
         return out
+
+    # -- serving telemetry readout --------------------------------------
+    def server_stats(self) -> dict:
+        """Flat numeric request-lifecycle summary: queue-wait / TTFT /
+        tok-per-s / prefix-hit / occupancy p50-p95-p99-mean-count plus
+        the engine counters.  The shape bench JSON lines and
+        MetricsWriter rows consume (``BaseTrainer.train`` writes it
+        ``serving_``-prefixed at the end of a run)."""
+        stats = self.telemetry.summary()
+        stats["preempted_requests"] = float(self.preemptions)
+        stats["prefix_cached_pages"] = float(self.prefix_cached_pages)
+        stats["page_pool_size"] = float(self.num_pages)
+        return stats
+
+    def reset_server_stats(self) -> None:
+        """Drop accumulated telemetry/counters (bench measurement
+        windows); in-flight request marks survive."""
+        self.telemetry.reset()
+        self.preemptions = 0
+        self.prefix_cached_pages = 0
 
     # -- host driver ----------------------------------------------------
     def generate(self, requests: Iterable[Tuple[int, np.ndarray]],

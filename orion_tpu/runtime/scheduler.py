@@ -293,6 +293,9 @@ class _NativeScheduler:
     def running(self) -> int:
         return self._lib.osch_running(self._h)
 
+    def stats(self) -> dict:
+        return _sched_stats(self)
+
 
 class PyScheduler:
     """Pure-Python mirror of the native scheduler (same contract,
@@ -568,6 +571,21 @@ class PyScheduler:
     @property
     def running(self) -> int:
         return len(self._running)
+
+    def stats(self) -> dict:
+        return _sched_stats(self)
+
+
+def _sched_stats(sched) -> dict:
+    """Page/queue gauges for telemetry (orion_tpu.obs): one dict read
+    per wave, identical shape for both scheduler implementations."""
+    return {
+        "free_pages": int(sched.free_pages),
+        "available_pages": int(sched.available_pages),
+        "cached_pages": int(sched.cached_total),
+        "waiting": int(sched.waiting),
+        "running": int(sched.running),
+    }
 
 
 def Scheduler(num_pages: int, page_size: int, max_slots: int,

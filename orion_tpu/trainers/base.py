@@ -248,6 +248,12 @@ class BaseTrainer:
             from orion_tpu.utils.metrics import MetricsWriter
 
             self.writer = MetricsWriter(cfg.log_dir)
+        # Observability (orion_tpu.obs): cfg.obs.trace arms the span
+        # tracer (+ flight recorder, dumping into log_dir) for this
+        # process; close() releases it like the recompile sentinel.
+        from orion_tpu.obs import install_from_config as _obs_install
+
+        self._obs = _obs_install(cfg)
         # Opt-in runtime guards (orion_tpu.analysis.runtime_guards):
         # recompile sentinel installs here; the transfer guard wraps
         # the train() loop body.
@@ -257,14 +263,24 @@ class BaseTrainer:
 
     def close(self) -> None:
         """Release process-global hooks (the recompile sentinel's log
-        handler + jax_log_compiles flag).  Idempotent; also runs from
-        __del__ so sweep scripts constructing many trainers don't
+        handler + jax_log_compiles flag, the obs tracer/flight
+        recorder) and close the metrics writer — THE trainer/
+        orchestrator exit path for every sink.  Idempotent; also runs
+        from __del__ so sweep scripts constructing many trainers don't
         accumulate handlers, but an explicit close() is the reliable
         path."""
         sentinel = getattr(self, "_recompile_sentinel", None)
         if sentinel is not None:
             sentinel.uninstall()
             self._recompile_sentinel = None
+        obs_session = getattr(self, "_obs", None)
+        if obs_session is not None:
+            obs_session.uninstall()
+            self._obs = None
+        writer = getattr(self, "writer", None)
+        if writer is not None:
+            writer.close()
+            self.writer = None
 
     def __del__(self):  # pragma: no cover - interpreter-dependent
         try:
@@ -696,6 +712,8 @@ class BaseTrainer:
         """
         import time
 
+        from orion_tpu import obs
+
         if num_iterations is not None:
             n = num_iterations
         else:
@@ -745,13 +763,16 @@ class BaseTrainer:
                     self._pending_meta = pending
                     pending = None
                 with guard_scope(self.cfg.transfer_guard), \
-                        jax.named_scope("experience"):
+                        jax.named_scope("experience"), \
+                        obs.span("experience", it=it):
                     experience, exp_stats = self.make_experience(batch)
                 t1 = time.perf_counter()
                 with guard_scope(self.cfg.transfer_guard), \
-                        jax.named_scope("update"):
+                        jax.named_scope("update"), \
+                        obs.span("update", it=it):
                     upd_dev = self.update_epochs(experience, defer=True)
-                self.sync_weights()
+                with obs.span("weight_sync"):
+                    self.sync_weights()
                 t2 = time.perf_counter()
                 self.global_iter += 1
                 pending = {
@@ -789,14 +810,50 @@ class BaseTrainer:
                 fetched = jax.device_get(pending["dev"])
                 self._finalize_iteration(pending, fetched,
                                          now=time.perf_counter())
+        except BaseException as e:
+            # Forensics before the crash surfaces (no-op unless
+            # cfg.obs armed the flight recorder).
+            obs.flight_dump("unhandled-exception",
+                            {"error": repr(e), "loop": "sync",
+                             "global_iter": self.global_iter})
+            raise
         finally:
             self._defer_stats = False
             self._pending_fetch = None
             self._pending_meta = None
-        prof.stop()
+            # The profiler stop lives in the finally: an exception
+            # escaping the loop used to leave jax.profiler's trace
+            # session dangling, poisoning the NEXT start_trace (the
+            # obs tracer's export or a later profiled run).
+            prof.stop()
+        if prof.traced:
+            # Surface the trace dir in the final metrics row so users
+            # can find the artifact without grepping the config.
+            if self.metrics_history:
+                self.metrics_history[-1]["profile_dir"] = prof.dir
+            if self.writer is not None:
+                self.writer.write(self.global_iter,
+                                  {"profile_dir": prof.dir})
+        self._write_serving_stats()
         if self.ckpt is not None:
             self.ckpt.wait()
         return self.metrics_history
+
+    def _write_serving_stats(self, engine=None) -> None:
+        """Serving-telemetry summary row (continuous engine only):
+        queue wait / TTFT / tok/s / occupancy histograms flow through
+        MetricsWriter as p50/p95/p99 columns at the end of a train
+        call.  ``engine`` lets the async orchestrator report ITS
+        rollout-group engine (the one that actually served) instead of
+        the trainer's sync-path engine; the pool path has no local
+        engine — each worker process owns its own telemetry."""
+        engine = self.engine if engine is None else engine
+        stats_fn = getattr(engine, "server_stats", None)
+        if stats_fn is None or self.writer is None:
+            return
+        stats = {f"serving_{k}": v for k, v in stats_fn().items()}
+        if stats:
+            self.writer.write(self.global_iter, stats)
 
     def _finalize_iteration(self, pending: dict, fetched: dict,
                             now: float) -> None:
@@ -836,28 +893,59 @@ class BaseTrainer:
 class _ProfileWindow:
     """Starts/stops a jax.profiler trace over the configured iteration
     window (SURVEY.md §5 tracing).  Dumps xplane + perfetto trace under
-    ``cfg.profile_dir`` — viewable in tensorboard / Perfetto."""
+    ``cfg.profile_dir`` — viewable in tensorboard / Perfetto (and
+    mergeable next to the orion_tpu.obs span traces).
+
+    Hardened (ISSUE 9 satellite): jax.profiler keeps ONE process-global
+    trace session, so a dangling ``start_trace`` — ours after a
+    mid-window crash, or another component's — used to poison every
+    later window.  ``start`` failures now disable the window loudly
+    instead of killing the run, ``stop`` is idempotent and never masks
+    the loop's real exception, and callers run it from their
+    ``finally``.  ``traced`` records whether a trace was captured so
+    the trainer can surface ``profile_dir`` in the final metrics row.
+    """
 
     def __init__(self, cfg: TrainConfig):
         self.dir = cfg.profile_dir
         self.start_it = cfg.profile_start
         self.stop_it = cfg.profile_start + cfg.profile_steps
         self.active = False
+        self.traced = False
 
     def step(self, it: int) -> None:
         if self.dir is None or self.stop_it <= self.start_it:
             return
-        if it == self.start_it:
-            jax.profiler.start_trace(self.dir)
+        if it == self.start_it and not self.active:
+            try:
+                jax.profiler.start_trace(self.dir)
+            except Exception as e:
+                # Another trace session is live (dangling from a crash
+                # elsewhere, or a concurrent profiler): skip THIS
+                # window loudly rather than abort the training run.
+                import warnings
+
+                warnings.warn(
+                    f"profile window could not start_trace({self.dir!r})"
+                    f": {e!r} — window skipped (a dangling session from "
+                    "an earlier crash?)", stacklevel=2)
+                self.dir = None
+                return
             self.active = True
+            self.traced = True
         elif it == self.stop_it and self.active:
-            jax.profiler.stop_trace()
-            self.active = False
+            self.stop()
 
     def stop(self) -> None:
-        if self.active:
+        """Idempotent; safe under an in-flight exception (a failed
+        stop must never mask the loop's real error)."""
+        if not self.active:
+            return
+        self.active = False
+        try:
             jax.profiler.stop_trace()
-            self.active = False
+        except Exception:  # pragma: no cover - dangling-session races
+            pass
 
 
 def _np_state_to_json(state: tuple) -> list:

@@ -8,7 +8,6 @@ also runs the SPMD partitioner and checks collective legality).
 
 from __future__ import annotations
 
-import time
 from typing import Optional
 
 import jax
@@ -89,33 +88,35 @@ def lower_8b_update(mesh=None, compile: bool = False) -> str:
     ``compile=True`` runs the SPMD partitioner over it.  Returns a
     short status string.
     """
+    from orion_tpu import obs
     from orion_tpu.trainers.base import BaseTrainer
 
-    t0 = time.perf_counter()
-    shell, pshape, mb = _build_8b_shell()
-    if mesh is not None:
-        from orion_tpu.models.sharded import mesh_shardings_for
+    # obs.timed measures even with tracing off; with it, the 8B lower/
+    # compile shows up as one span on the run's timeline.
+    with obs.timed("compile.8b_update", compile=compile) as sp:
+        shell, pshape, mb = _build_8b_shell()
+        if mesh is not None:
+            from orion_tpu.models.sharded import mesh_shardings_for
 
-        init_args = (jnp.zeros((1, 2), jnp.int32),
-                     jnp.zeros((1, 2), jnp.int32))
-        shardings = mesh_shardings_for(shell.model, mesh, init_args)
-        pshape = jax.tree.map(
-            lambda x, s: jax.ShapeDtypeStruct(x.shape, x.dtype,
-                                              sharding=s),
-            pshape, shardings)
-    state = _abstract_state(shell, pshape)
-    B = shell.cfg.minibatch_size
+            init_args = (jnp.zeros((1, 2), jnp.int32),
+                         jnp.zeros((1, 2), jnp.int32))
+            shardings = mesh_shardings_for(shell.model, mesh, init_args)
+            pshape = jax.tree.map(
+                lambda x, s: jax.ShapeDtypeStruct(x.shape, x.dtype,
+                                                  sharding=s),
+                pshape, shardings)
+        state = _abstract_state(shell, pshape)
+        B = shell.cfg.minibatch_size
 
-    def update(state, mb):
-        idx = jnp.arange(B)
-        return BaseTrainer._update_fn(shell, state, mb, idx)
+        def update(state, mb):
+            idx = jnp.arange(B)
+            return BaseTrainer._update_fn(shell, state, mb, idx)
 
-    lowered = jax.jit(update).lower(state, mb)
-    if compile:
-        lowered.compile()
-    n = sum(int(jnp.prod(jnp.asarray(x.shape)))
-            for x in jax.tree.leaves(pshape))
-    dt = time.perf_counter() - t0
+        lowered = jax.jit(update).lower(state, mb)
+        if compile:
+            lowered.compile()
+        n = sum(int(jnp.prod(jnp.asarray(x.shape)))
+                for x in jax.tree.leaves(pshape))
     verb = "compiled" if compile else "lowered"
     where = f"on {dict(mesh.shape)}" if mesh is not None else "1-device"
-    return f"ok ({n/1e9:.2f}B params {verb} {where} in {dt:.0f}s)"
+    return f"ok ({n/1e9:.2f}B params {verb} {where} in {sp.duration:.0f}s)"

@@ -39,7 +39,7 @@ def timed_fetch(fn, *args, n=4):
     for _ in range(n):
         t0 = time.perf_counter()
         np.asarray(fn(*args))
-        ts.append(time.perf_counter() - t0)
+        ts.append(time.perf_counter() - t0)  # orion: ignore[naked-timer] bench wall window, blocked above
     return float(np.median(ts))
 
 

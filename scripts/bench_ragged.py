@@ -163,7 +163,7 @@ def serve_dense(dense, sh, prompts, budgets, arrivals):
     queue = []
     i_next = 0
     while i_next < N or queue:
-        now = time.perf_counter() - t0  # orion: ignore[bench-no-block] arrival-clock read, not a timing window
+        now = time.perf_counter() - t0  # orion: ignore[bench-no-block, naked-timer] arrival-clock read, not a timing window
         while i_next < N and arrivals[i_next] <= now:
             queue.append(i_next)
             i_next += 1
@@ -171,7 +171,7 @@ def serve_dense(dense, sh, prompts, budgets, arrivals):
             # wait for arrivals (standard batch-collect policy)
             if i_next < N:
                 time.sleep(max(0.0, arrivals[i_next] -
-                               (time.perf_counter() - t0)))  # orion: ignore[bench-no-block] arrival-clock read
+                               (time.perf_counter() - t0)))  # orion: ignore[bench-no-block, naked-timer] arrival-clock read
             continue
         batch, queue = queue[:B], queue[B:]
         bb = budgets[batch]
@@ -187,10 +187,10 @@ def serve_dense(dense, sh, prompts, budgets, arrivals):
         r = dense.generate(jnp.asarray(ids), jnp.asarray(lens),
                            jax.random.key(batch[0]), max_new_tokens=t)
         np.asarray(r.completion_lens)  # real fetch
-        tdone = time.perf_counter() - t0  # orion: ignore[bench-no-block] completion_lens fetch above drained the batch
+        tdone = time.perf_counter() - t0  # orion: ignore[bench-no-block, naked-timer] completion_lens fetch above drained the batch
         for gi in batch:
             done_t[gi] = tdone
-    return time.perf_counter() - t0, done_t
+    return time.perf_counter() - t0, done_t  # orion: ignore[naked-timer] the bench's wall window IS the metric
 
 
 def serve_continuous(cont, sh, prompts, budgets, arrivals, deadlines):
@@ -203,7 +203,7 @@ def serve_continuous(cont, sh, prompts, budgets, arrivals, deadlines):
     n_done = 0
     i_next = 0
     while n_done < N:
-        now = time.perf_counter() - t0  # orion: ignore[bench-no-block] arrival-clock read, not a timing window
+        now = time.perf_counter() - t0  # orion: ignore[bench-no-block, naked-timer] arrival-clock read, not a timing window
         while i_next < N and arrivals[i_next] <= now:
             cont.submit(i_next, prompts[i_next],
                         budget=int(budgets[i_next]),
@@ -212,12 +212,12 @@ def serve_continuous(cont, sh, prompts, budgets, arrivals, deadlines):
         if cont.pending == 0:
             # idle: nothing in flight, wait for the next arrival
             time.sleep(max(0.0, arrivals[i_next] -
-                           (time.perf_counter() - t0)))  # orion: ignore[bench-no-block] arrival-clock read
+                           (time.perf_counter() - t0)))  # orion: ignore[bench-no-block, naked-timer] arrival-clock read
             continue
         for r in cont.step():  # step drains completions to host
-            done_t[r.req_id] = time.perf_counter() - t0  # orion: ignore[bench-no-block] step() fetched this completion
+            done_t[r.req_id] = time.perf_counter() - t0  # orion: ignore[bench-no-block, naked-timer] step() fetched this completion
             n_done += 1
-    return time.perf_counter() - t0, done_t  # orion: ignore[bench-no-block] step() fetched every completion
+    return time.perf_counter() - t0, done_t  # orion: ignore[bench-no-block, naked-timer] step() fetched every completion
 
 
 def warm_buckets(dense, cont, sh):
@@ -249,8 +249,7 @@ def warm_buckets(dense, cont, sh):
                 assert waves < 10000
         nb *= 2
     cont.sched.clear_cache()
-    cont.prefix_cached_pages = 0
-    cont.preemptions = 0
+    cont.reset_server_stats()
 
 
 def run(sh=None, seed=None, record=True):
@@ -273,12 +272,11 @@ def run(sh=None, seed=None, record=True):
     print(f"[calibrate] continuous capacity ~{cap:.0f} tok/s "
           f"(warm, {len(wp)} req)", flush=True)
 
-    # Counters and prefix cache reset AFTER calibration, so the
-    # reported metrics cover the measured trace only and neither arm
-    # starts with a calibration-populated cache.
+    # Counters, telemetry histograms, and prefix cache reset AFTER
+    # calibration, so the reported metrics cover the measured trace
+    # only and neither arm starts with a calibration-populated cache.
     cont.sched.clear_cache()
-    cont.prefix_cached_pages = 0
-    cont.preemptions = 0
+    cont.reset_server_stats()
     prompts, budgets, arrivals, deadlines = make_trace(
         sh, seed=seed, cap_toks_per_sec=cap)
     tot = int(budgets.sum())
@@ -294,6 +292,17 @@ def run(sh=None, seed=None, record=True):
     hit_c = float((done_c <= deadlines).mean())
     lat_d = float((done_d - arrivals).mean())
     lat_c = float((done_c - arrivals).mean())
+
+    # Request-latency distribution (continuous arm) + the engine's
+    # own lifecycle telemetry (queue wait, TTFT, tok/s, occupancy —
+    # orion_tpu.obs histograms, ISSUE 9): p50/p95/p99 join the JSON
+    # line so the serving tail, not just the mean, is a recorded
+    # regression surface.
+    from orion_tpu.utils.metrics import Histogram
+
+    lat_hist = Histogram()
+    for v in (done_c - arrivals):
+        lat_hist.record(float(v))
 
     out = {
         "metric": "ragged arrivals-trace generated tokens/sec "
@@ -314,20 +323,37 @@ def run(sh=None, seed=None, record=True):
         "total_tokens": tot,
         "arrival_span": round(span, 3),
     }
+    out.update({k: round(float(v), 4)
+                for k, v in lat_hist.summary("serving_latency").items()})
+    out["serving_p95_latency"] = out["serving_latency_p95"]
+    out.update({f"serving_{k}": round(float(v), 4)
+                for k, v in cont.server_stats().items()})
     if record:
         self_path = os.path.join(os.path.dirname(os.path.dirname(
             os.path.abspath(__file__))), "BENCH_SELF.json")
         key = f"ragged_trace_cont_toks_per_sec_{sh['model']}"
+        lat_key = f"serving_p95_latency_{sh['model']}"
         base = {}
         if os.path.exists(self_path):
             with open(self_path) as f:
                 base = json.load(f)
+        changed = False
         if key not in base:
             base[key] = out["value"]
+            changed = True
+        if lat_key not in base:
+            # Tail-latency regression signal (lower is better):
+            # recorded once, compared by later rounds.
+            base[lat_key] = out["serving_p95_latency"]
+            changed = True
+        if changed:
             with open(self_path, "w") as f:
                 json.dump(base, f, indent=1)
         out["vs_baseline"] = round(out["value"] / base[key], 4) \
             if base[key] else 1.0
+        out["p95_latency_vs_baseline"] = \
+            round(out["serving_p95_latency"] / base[lat_key], 4) \
+            if base.get(lat_key) else 1.0
     print(json.dumps(out))
     return out
 

@@ -40,7 +40,7 @@ def timed_fetch(fn, *args, n=5):
     for _ in range(n):
         t0 = time.perf_counter()
         np.asarray(fn(*args))
-        ts.append(time.perf_counter() - t0)
+        ts.append(time.perf_counter() - t0)  # orion: ignore[naked-timer] bench wall window, blocked above
     return float(np.median(ts))
 
 
@@ -93,7 +93,7 @@ def main():
     for _ in range(3):
         t0 = time.perf_counter()
         gen()  # host-complete: gen() ends in np.asarray
-        ts.append(time.perf_counter() - t0)  # orion: ignore[bench-no-block]
+        ts.append(time.perf_counter() - t0)  # orion: ignore[bench-no-block, naked-timer]
     t_gen = float(np.median(ts))
     print(f"engine.generate end-to-end: {t_gen*1e3:.0f} ms "
           f"({(t_gen - rtt)/T*1e3:.2f} ms/step upper bound after RTT)")

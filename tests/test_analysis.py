@@ -431,6 +431,48 @@ FIXTURES = [
         "orion_tpu/fake_worker2.py",
     ),
     (
+        "naked-timer",
+        """
+        import time
+
+        def measure(f):
+            t0 = time.monotonic()
+            f()
+            return time.monotonic() - t0
+        """,
+        """
+        from orion_tpu.obs import timed
+
+        def measure(f):
+            with timed("measure") as sp:
+                f()
+            return sp.duration
+        """,
+        "orion_tpu/fake_timing.py",
+    ),
+    (
+        "naked-timer",
+        """
+        import time
+
+        def step_rate(step):
+            t0 = time.time()
+            step()
+            dt = time.time() - t0
+            return 1.0 / dt
+        """,
+        """
+        import time
+
+        def wait_until(cond, timeout):
+            deadline = time.monotonic() + timeout
+            while not cond():
+                if time.monotonic() - deadline > 0:
+                    raise TimeoutError("deadline")
+        """,
+        "orion_tpu/fake_timing.py",
+    ),
+    (
         "raw-socket",
         """
         import socket
@@ -486,6 +528,41 @@ def test_every_rule_has_fixture_coverage():
     assert covered == {r.id for r in RULES}, \
         "each registered rule needs a positive+negative fixture here"
     assert len(RULES) >= 10
+
+
+def test_naked_timer_exempts_obs_and_tests():
+    """orion_tpu/obs IS the timing layer and tests time their own
+    scaffolding freely — the same delta fires everywhere else."""
+    snippet = """
+    import time
+
+    def measure(f):
+        t0 = time.perf_counter()
+        f()
+        return time.perf_counter() - t0
+    """
+    assert "naked-timer" in ids_of(run_on(snippet, "orion_tpu/rollout/x.py"))
+    assert "naked-timer" not in ids_of(
+        run_on(snippet, "orion_tpu/obs/trace.py"))
+    assert "naked-timer" not in ids_of(run_on(snippet, "tests/test_x.py"))
+
+
+def test_naked_timer_deadline_arithmetic_is_clean():
+    """`deadline = now + timeout` and `remaining = deadline - now` are
+    deadline bookkeeping, not timing measurements — the rule must not
+    fire on the retry/connect-backoff idiom."""
+    snippet = """
+    import time
+
+    def connect(timeout):
+        deadline = time.monotonic() + timeout
+        while True:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise TimeoutError
+    """
+    assert "naked-timer" not in ids_of(
+        run_on(snippet, "orion_tpu/fake_io.py"))
 
 
 def test_raw_socket_allowed_only_in_remote_py():

@@ -124,7 +124,7 @@ def test_version_mismatch_raises_protocol_error():
     raw = _raw_connect(port)
     t.join(timeout=20)
     try:
-        raw.sendall(_HEADER.pack(MAGIC, PROTOCOL_VERSION + 1, 0, 0))
+        raw.sendall(_HEADER.pack(MAGIC, PROTOCOL_VERSION + 1, 0, 0, 0, 0))
         with pytest.raises(ProtocolError, match="version mismatch"):
             out["chan"].recv_frame()
     finally:
@@ -175,7 +175,7 @@ class FakeWorker:
     TCP pool protocol through a real PoolWorkerClient."""
 
     def __init__(self, port: int, rank: int, n_batches=None,
-                 fail_at=None, staleness: int = 1):
+                 fail_at=None, staleness: int = 1, tracer=None):
         self.rank = rank
         self.sent = None
         self.error = None
@@ -186,7 +186,7 @@ class FakeWorker:
             try:
                 self.client = PoolWorkerClient(
                     port, name=f"fake-{rank}", heartbeat_interval=0.05,
-                    connect_timeout=20, seed=rank)
+                    connect_timeout=20, seed=rank, tracer=tracer)
                 self._ready.set()
                 rng = np.random.RandomState(1000 + rank)
 
