@@ -250,19 +250,36 @@ class RolloutConfig:
     # training graph is never quantized.
     quantize_weights: bool = False
     quantize_kv: bool = False
-    # Speculative decoding (simple engine): draft speculative_k tokens
-    # per step by prompt-lookup (match the trailing spec_ngram-gram
-    # against earlier sequence content) and verify all k+1 positions
-    # in ONE chunked forward — decode is HBM-bound, so a step that
-    # emits m+1 tokens reads the weights once instead of m+1 times.
-    # 0 disables.  Exact in both modes: greedy output is bit-identical
-    # to sequential decode; temperature>0 uses delta-draft speculative
+    # Speculative decoding: draft speculative_k tokens per step by
+    # prompt-lookup (match the trailing spec_ngram-gram against
+    # earlier sequence content) and verify all k+1 positions in ONE
+    # chunked forward — decode is HBM-bound, so a step that emits m+1
+    # tokens reads the weights once instead of m+1 times.  0 disables.
+    # Exact in both modes: greedy output is token-identical to
+    # sequential decode; temperature>0 uses delta-draft speculative
     # sampling whose emitted-token marginal is exactly the tempered
     # sampling distribution (behavior logprobs stay correct for the
-    # async importance ratio).  Scope: dense cache, no repetition
-    # penalty / min_new_tokens.
+    # async importance ratio).
+    # Simple engine (v1): dense cache only, no repetition penalty /
+    # min_new_tokens.  Continuous engine (v2, PR 10): per-slot
+    # draft/verify over the paged pool with k slack positions per
+    # reservation, composing with repetition_penalty / min_new_tokens
+    # / EOS-stop-in-chunk and with prefix cache + chunked prefill.
     speculative_k: int = 0
     spec_ngram: int = 2
+    # Adaptive k (continuous engine): track a per-request acceptance
+    # EMA and skip the verify chunk for waves whose decoding slots all
+    # draft below `spec_breakeven` emitted tokens per verify step (the
+    # measured chunk-cost breakeven, ~1.55-1.6x a plain decode step on
+    # chip) — cold workloads degrade to plain decode instead of paying
+    # the chunk tax, which is what makes speculative_k safe to leave
+    # on for the continuous path.  `spec_probe_period` forces one
+    # probing verify wave after that many consecutive plain waves so a
+    # workload shift (random -> structured) is re-detected; 0 never
+    # re-probes.
+    spec_adaptive: bool = True
+    spec_breakeven: float = 1.6
+    spec_probe_period: int = 64
     # Shared-prefix group admission (continuous engine): when a trainer
     # samples k completions per prompt (GRPO/RLOO/Online-DPO), prefill
     # each unique prompt once and share its fully-filled prompt pages
@@ -352,6 +369,15 @@ class RolloutConfig:
         if self.speculative_k > 0 and self.spec_ngram < 1:
             raise ValueError(
                 f"spec_ngram must be >= 1, got {self.spec_ngram}")
+        if self.spec_breakeven < 1.0:
+            raise ValueError(
+                f"spec_breakeven must be >= 1.0 (tokens per verify "
+                f"step; a plain step emits exactly 1), got "
+                f"{self.spec_breakeven}")
+        if self.spec_probe_period < 0:
+            raise ValueError(
+                f"spec_probe_period must be >= 0 (0 never re-probes), "
+                f"got {self.spec_probe_period}")
         if not 0 <= self.min_new_tokens <= self.max_new_tokens:
             raise ValueError(
                 f"min_new_tokens={self.min_new_tokens} outside "
@@ -454,9 +480,14 @@ class ResilienceConfig:
     # non-finite values instead of feeding them to the update step.
     quarantine_nonfinite: bool = True
     # -- cross-process worker pool (orchestration.remote.WorkerPool) ---
-    # Rollout worker processes the learner waits for before training
-    # starts (elastic: more may join, members may leave/rejoin mid-run).
-    pool_size: int = 1
+    # Rollout worker PROCESSES: 0 (default) keeps async_mode on the
+    # in-process AsyncOrchestrator rollout thread; > 0 makes launch.py
+    # spawn this many rollout worker processes itself and train
+    # through PoolOrchestrator, which waits for this quorum before the
+    # first iteration (elastic after that: more may join, members may
+    # leave/rejoin mid-run).  Callers assembling their own pool pass
+    # it to PoolOrchestrator directly and set this to the quorum.
+    pool_size: int = 0
     # Worker-side heartbeat send cadence (seconds).  The learner-side
     # stall cutoff is `heartbeat_timeout` above (shared with the
     # in-process supervisor); keep timeout >> interval.

@@ -4,6 +4,15 @@ Usage:
   python -m orion_tpu.launch <algo> [--config cfg.yaml] [key=value ...]
   algo ∈ {ppo, grpo, rloo, online_dpo}
 
+Cross-process rollout pool (PR 10, ROADMAP item 1 leftover): with
+``async_mode=true resilience.pool_size=N`` (N > 0) the launcher itself
+spawns N rollout worker PROCESSES — each re-execs this entrypoint with
+the same config plus ``ORION_POOL_WORKER_PORT``/``_RANK`` env routing
+it into :func:`run_pool_worker` — and trains through
+``PoolOrchestrator`` (elastic membership, per-worker heartbeats,
+dead-worker discard; see orchestration/remote.py).  ``pool_size=0``
+(default) keeps async mode on the in-process rollout thread.
+
 Examples (the five SPEC configs, BASELINE.json):
   # 5: GRPO math with rule-based reward, fully offline
   python -m orion_tpu.launch grpo data.dataset=synthetic reward=math \
@@ -147,6 +156,150 @@ def build_reward(cfg, tokenizer, mesh):
     raise ValueError(f"unknown reward spec: {spec!r}")
 
 
+def run_pool_worker(cfg, port: int, rank: int,
+                    host: str = "localhost",
+                    n_batches: Optional[int] = None) -> int:
+    """Rollout-worker process body: a policy decode engine + reward
+    scorer behind a :class:`PoolWorkerClient` generation loop.  No
+    optimizer, no reference model — weights arrive from the learner
+    (initial snapshot rides the HELLO ack, updates stream as WEIGHTS
+    frames), experience leaves as TRAJ frames, and the protocol shape
+    (staleness gate, version tags, crash-vs-leave semantics, SIGTERM
+    graceful leave) lives in the client.  Reused in-process by the
+    tier-1 launch smoke (threads instead of processes — the same
+    harness the pool tests drive).  Returns batches sent."""
+    import threading
+
+    from orion_tpu.orchestration.remote import PoolWorkerClient
+    from orion_tpu.resilience.preemption import install_handler
+    from orion_tpu.rollout import RolloutEngine
+    from orion_tpu.trainers.base import dispatch_generate_batch
+
+    tokenizer = load_tokenizer(cfg.data.tokenizer)
+    if cfg.data.tokenizer in (None, "byte"):
+        cfg.model.vocab_size = max(cfg.model.vocab_size, 260)
+    eos = getattr(tokenizer, "eos_token_id", None)
+    pad = getattr(tokenizer, "pad_token_id", 0) or 0
+    model = Transformer(cfg.model)
+    if cfg.rollout.engine == "continuous":
+        from orion_tpu.rollout.continuous import ContinuousBatchingEngine
+
+        engine = ContinuousBatchingEngine(
+            model, cfg.model, cfg.rollout, eos_token_id=eos,
+            pad_token_id=pad, segment_len=cfg.rollout.segment_len)
+    else:
+        engine = RolloutEngine(model, cfg.model, cfg.rollout,
+                               eos_token_id=eos, pad_token_id=pad)
+    # Model-backed rewards shard on this process's own local mesh;
+    # host rewards (math/length) never touch one.
+    mesh = (make_mesh(cfg.mesh)
+            if cfg.reward.startswith(("model:", "judge:")) else None)
+    reward_fn = build_reward(cfg, tokenizer, mesh)
+    wants_device = getattr(reward_fn, "wants_device_result", False)
+    # Each worker owns a disjoint prompt shard (seed-offset stream) —
+    # pool mode's data contract (the learner's prompt_iter feeds only
+    # the degraded sync path).
+    prompt_iter = build_prompt_iterator(
+        cfg.data.dataset, tokenizer, cfg.rollout_batch_size,
+        cfg.rollout.max_prompt_len, split=cfg.data.split,
+        seed=cfg.seed + 7919 * (rank + 1),
+        use_chat_template=cfg.data.use_chat_template,
+        system_prompt=cfg.data.system_prompt,
+        synthetic_size=cfg.data.synthetic_size,
+        data_dir=cfg.data.data_dir)
+    k = int(getattr(cfg, "group_size", 1))
+    # SIGTERM on a worker = graceful leave (the learner sees a LEAVE,
+    # not a crash).  Signal handlers only install on the main thread —
+    # the in-process test harness runs this body on a daemon thread
+    # and polls nothing.
+    handler = None
+    if threading.current_thread() is threading.main_thread():
+        handler = install_handler()
+
+    def gen(i: int, version: int, params_host):
+        batch = next(prompt_iter)
+        ids = np.asarray(batch["prompt_ids"])
+        lens = np.asarray(batch["prompt_lens"], np.int32)
+        meta = {key: np.asarray(v) for key, v in batch.items()
+                if key not in ("prompt_ids", "prompt_lens")}
+        if k > 1:
+            ids = np.repeat(ids, k, axis=0)
+            lens = np.repeat(lens, k, axis=0)
+            meta = {key: np.repeat(v, k, axis=0)
+                    for key, v in meta.items()}
+        params = jax.device_put(params_host)
+        rng = jax.random.fold_in(
+            jax.random.key(cfg.seed + 4242 + 1000003 * rank), i)
+        if hasattr(engine, "generate_batch"):
+            result = dispatch_generate_batch(engine, ids, lens, rng,
+                                             group_size=k, params=params)
+        else:
+            result = engine.generate(jnp.asarray(ids),
+                                     jnp.asarray(lens), rng,
+                                     params=params)
+        host = result.to_host()
+        scores = reward_fn(result if wants_device else host, meta)
+        return {"result": host._fields(),
+                "scores": np.asarray(scores, np.float32)}
+
+    client = PoolWorkerClient.from_config(
+        cfg.resilience, port, host=host,
+        name=f"launch-worker-{rank}", seed=cfg.seed + rank)
+    return client.run(gen, n_batches=n_batches, preemption=handler)
+
+
+def spawn_pool_workers(algo: str, argv: list, port: int, n: int) -> list:
+    """Spawn ``n`` rollout worker processes re-execing this entrypoint
+    with the same CLI args; env vars route them into
+    :func:`run_pool_worker`.  Returns the Popen handles (the tier-1
+    smoke monkeypatches this with the in-process thread harness).
+
+    Device placement: children inherit the parent's environment, so
+    on a single TPU host they would contend for the chips the learner
+    already holds (libtpu is single-process per chip).  Same-host
+    workers must be pointed elsewhere with
+    ``ORION_POOL_WORKER_PLATFORM`` (exported to the children as their
+    ``JAX_PLATFORMS``, e.g. ``cpu``) or per-rank device isolation via
+    ``ORION_POOL_WORKER_ENV_<rank>`` (``KEY=V,KEY2=V2``, e.g.
+    ``TPU_VISIBLE_DEVICES``); multi-host pods set neither and give
+    each worker its own host."""
+    import subprocess
+
+    worker_platform = os.environ.get("ORION_POOL_WORKER_PLATFORM")
+    procs = []
+    for rank in range(n):
+        env = dict(os.environ)
+        env["ORION_POOL_WORKER_PORT"] = str(port)
+        env["ORION_POOL_WORKER_RANK"] = str(rank)
+        if worker_platform:
+            env["JAX_PLATFORMS"] = worker_platform
+        extra = os.environ.get(f"ORION_POOL_WORKER_ENV_{rank}")
+        if extra:
+            for kv in extra.split(","):
+                key, _, val = kv.partition("=")
+                env[key.strip()] = val
+        procs.append(subprocess.Popen(
+            [sys.executable, "-m", "orion_tpu.launch", algo] + list(argv),
+            env=env))
+    return procs
+
+
+def _reap_pool_workers(procs: list, timeout: float = 60.0) -> None:
+    """Wait for GOODBYE'd workers to exit; escalate to terminate/kill
+    so a wedged worker can never hang the launcher's exit."""
+    import subprocess
+
+    for p in procs:
+        try:
+            p.wait(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            p.terminate()
+            try:
+                p.wait(timeout=10.0)
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+
 def build_trainer(algo: str, cfg, mesh, tokenizer):
     _, trainer_cls = ALGOS[algo]
     shared = algo == "ppo" and cfg.share_backbone
@@ -183,6 +336,7 @@ def main(argv: Optional[list] = None) -> Any:
               "[--config cfg.yaml] [key=value ...]", file=sys.stderr)
         raise SystemExit(2)
     algo = argv.pop(0)
+    raw_argv = list(argv)  # worker processes re-exec with these
     yaml_path = None
     if "--config" in argv:
         i = argv.index("--config")
@@ -192,6 +346,17 @@ def main(argv: Optional[list] = None) -> Any:
     cfg = load_config(cfg_cls, yaml_path=yaml_path, cli_args=argv)
     if cfg.model_preset:
         cfg.model = getattr(ModelConfig, cfg.model_preset)()
+
+    # Rollout-worker process (spawned by the pool branch below): the
+    # env routing keeps the CLI surface unchanged — a worker re-parses
+    # the exact same config and runs the generation loop instead of
+    # training.
+    worker_port = os.environ.get("ORION_POOL_WORKER_PORT")
+    if worker_port is not None:
+        return run_pool_worker(
+            cfg, int(worker_port),
+            int(os.environ.get("ORION_POOL_WORKER_RANK", "0")),
+            host=os.environ.get("ORION_POOL_WORKER_HOST", "localhost"))
 
     if os.environ.get("JAX_COORDINATOR_ADDRESS"):
         jax.distributed.initialize()
@@ -235,6 +400,32 @@ def main(argv: Optional[list] = None) -> Any:
             system_prompt=cfg.data.system_prompt,
             synthetic_size=cfg.data.synthetic_size,
             data_dir=cfg.data.data_dir)
+
+    if cfg.async_mode and cfg.resilience.pool_size > 0:
+        # Cross-process rollout pool (ROADMAP item 1 leftover): the
+        # launcher spawns resilience.pool_size worker processes itself
+        # — each re-execs this entrypoint with the same args plus the
+        # ORION_POOL_WORKER_* env routing — and trains through
+        # PoolOrchestrator, which waits for that quorum, supervises
+        # membership, and GOODBYEs the workers on completion.  The
+        # train mesh keeps every local device (workers are separate
+        # processes with their own).
+        from orion_tpu.orchestration.async_orchestrator import (
+            PoolOrchestrator)
+
+        mesh = make_mesh(cfg.mesh)
+        with mesh:
+            trainer = build_trainer(algo, cfg, mesh, tokenizer)
+            trainer.resume(prompt_iter, eval_iter=eval_iter)
+            orch = PoolOrchestrator(trainer)  # pool built from config
+            procs = spawn_pool_workers(algo, raw_argv, orch.pool.port,
+                                       cfg.resilience.pool_size)
+            try:
+                return orch.train(prompt_iter, eval_iter=eval_iter)
+            finally:
+                trainer.close()
+                orch.pool.shutdown(goodbye=True)
+                _reap_pool_workers(procs)
 
     if cfg.async_mode:
         from orion_tpu.orchestration import AsyncOrchestrator, split_devices

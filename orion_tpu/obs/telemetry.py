@@ -42,6 +42,7 @@ class RequestTelemetry:
         self.tok_per_s = Histogram()
         self.prefix_hit_ratio = Histogram()
         self.page_occupancy = Histogram()
+        self.spec_acceptance = Histogram()
         self.finished = Counter()
         self.preempted = Counter()
 
@@ -98,6 +99,12 @@ class RequestTelemetry:
     def record_prefix_hit(self, ratio: float) -> None:
         self.prefix_hit_ratio.record(ratio)
 
+    def record_spec_acceptance(self, ratio: float) -> None:
+        """Per-finished-request speculative draft acceptance rate
+        (accepted / drafted over the request's whole life) — the
+        distribution behind the adaptive-k decision (PR 10)."""
+        self.spec_acceptance.record(ratio)
+
     # -- readout ---------------------------------------------------------
     def histograms(self) -> Dict[str, Histogram]:
         return {
@@ -106,6 +113,7 @@ class RequestTelemetry:
             "tok_per_s": self.tok_per_s,
             "prefix_hit_ratio": self.prefix_hit_ratio,
             "page_occupancy": self.page_occupancy,
+            "spec_acceptance": self.spec_acceptance,
         }
 
     def summary(self) -> Dict[str, float]:
@@ -127,6 +135,7 @@ class RequestTelemetry:
         self.tok_per_s = Histogram()
         self.prefix_hit_ratio = Histogram()
         self.page_occupancy = Histogram()
+        self.spec_acceptance = Histogram()
         self.finished = Counter()
         self.preempted = Counter()
         if not keep_marks:

@@ -368,8 +368,12 @@ class RolloutEngine:
             eq = jnp.ones((B, n_win), bool)
             for i in range(n):
                 eq &= seq[:, i: i + n_win] == tgt[:, i: i + 1]
-            # latest PRIOR occurrence: window start s with s+n < ln
-            valid = eq & (w_idx[None, :] + n < ln[:, None])
+            # latest PRIOR occurrence whose FULL gamma-token
+            # continuation lies inside the content — a match at the
+            # content edge would draft pads past it (a period-1 cycle
+            # then accepts ~1/gamma instead of the full chunk; found
+            # measuring the continuous port, PR 10)
+            valid = eq & (w_idx[None, :] + n + gamma <= ln[:, None])
             score = jnp.where(valid, w_idx[None, :], -1)
             s = jnp.max(score, axis=1)                      # [B], -1 = none
             s0 = jnp.maximum(s, 0)

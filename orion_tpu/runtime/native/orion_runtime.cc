@@ -187,18 +187,30 @@ class Scheduler {
     return n;
   }
 
-  // Grow a running request to hold `total_tokens` positions, appending
-  // freshly allocated pages to its table.  Returns the number of new
-  // pages (0 when already covered), -1 when the pool cannot supply
-  // them (the engine preempts and retries), -2 for an unknown id.
-  // Extend ignores the watermark: growth is exactly what the watermark
-  // reserve exists to serve.
-  int Extend(int64_t id, int total_tokens) {
+  // Grow a running request to hold `total_tokens` positions plus
+  // `slack` draft positions past them, appending freshly allocated
+  // pages to its table.  Returns the number of new pages (0 when
+  // already covered), -1 when the pool cannot supply them (the engine
+  // preempts and retries), -2 for an unknown id.  Extend ignores the
+  // watermark: growth is exactly what the watermark reserve exists to
+  // serve.
+  //
+  // `slack` is the speculative-verify extent (PR 10): a verify chunk
+  // writes up to k draft positions past the accepted content, so the
+  // reservation must cover them even though they may be rolled back
+  // (rejected drafts are overwritten in place, never freed — the
+  // extent only ever grows).  The lifetime cap stretches by the same
+  // slack: the final chunk may probe past the budget, and those
+  // writes land in reserved-but-never-attended slack, exactly like
+  // the dense engine's cache tail.
+  int Extend(int64_t id, int total_tokens, int slack) {
     auto it = running_.find(id);
     if (it == running_.end()) return -2;
+    if (slack < 0) slack = 0;
     Request& r = it->second;
-    int cap = (r.prompt_len + r.max_new + page_size_ - 1) / page_size_;
-    int need = (total_tokens + page_size_ - 1) / page_size_;
+    int cap =
+        (r.prompt_len + r.max_new + slack + page_size_ - 1) / page_size_;
+    int need = (total_tokens + slack + page_size_ - 1) / page_size_;
     if (need > cap) need = cap;
     int cur = static_cast<int>(r.pages.size());
     if (need <= cur) return 0;
@@ -501,8 +513,8 @@ int osch_cached_count(void* h, int64_t id) {
   return static_cast<Scheduler*>(h)->CachedCount(id);
 }
 
-int osch_extend(void* h, int64_t id, int total_tokens) {
-  return static_cast<Scheduler*>(h)->Extend(id, total_tokens);
+int osch_extend(void* h, int64_t id, int total_tokens, int slack) {
+  return static_cast<Scheduler*>(h)->Extend(id, total_tokens, slack);
 }
 
 int osch_preempt(void* h, int64_t id) {

@@ -10,8 +10,11 @@ tests/test_runtime_native.py).
 
 Contract (PR 8 serving rework): ON-DEMAND page allocation with
 mid-flight recycling — admission grants pages for the prompt + first
-token only, ``extend`` grows a running request segment by segment, and
-``preempt`` frees + requeues for restart when the pool runs dry.
+token only, ``extend`` grows a running request segment by segment
+(PR 10: plus an optional speculative-verify ``slack`` of draft
+positions past the growth target, rolled back in place on rejection,
+never freed), and ``preempt`` frees + requeues for restart when the
+pool runs dry.
 Admission is watermark-gated and policy-ordered (fifo / priority /
 deadline-EDF, no overtaking within the order).  Cross-request prefix
 caching shares hash-matched full prompt pages read-only (refcounted,
@@ -158,7 +161,7 @@ def _bind(so: Optional[str]):
                                ctypes.c_int]
     lib.osch_extend.restype = ctypes.c_int
     lib.osch_extend.argtypes = [ctypes.c_void_p, ctypes.c_int64,
-                                ctypes.c_int]
+                                ctypes.c_int, ctypes.c_int]
     for name in ("osch_slot", "osch_shared_count", "osch_cached_count",
                  "osch_preempt", "osch_finish"):
         fn = getattr(lib, name)
@@ -236,8 +239,9 @@ class _NativeScheduler:
             raise KeyError(req_id)
         return [int(out[i]) for i in range(n)]
 
-    def extend(self, req_id: int, total_tokens: int) -> int:
-        n = self._lib.osch_extend(self._h, req_id, total_tokens)
+    def extend(self, req_id: int, total_tokens: int,
+               slack: int = 0) -> int:
+        n = self._lib.osch_extend(self._h, req_id, total_tokens, slack)
         if n == -2:
             raise KeyError(req_id)
         return n
@@ -470,10 +474,17 @@ class PyScheduler:
         return self._running[req_id]["cached"]
 
     # -- growth / retirement -------------------------------------------
-    def extend(self, req_id: int, total_tokens: int) -> int:
+    def extend(self, req_id: int, total_tokens: int,
+               slack: int = 0) -> int:
+        """Grow to cover ``total_tokens`` positions + ``slack`` draft
+        positions past them (speculative-verify extents: a verify
+        chunk writes up to k rejected-draft positions that are rolled
+        back in place, never freed — the reservation only grows).  The
+        lifetime cap stretches by the same slack."""
         r = self._running[req_id]
-        cap = -(-(r["plen"] + r["mnew"]) // self._ps)
-        need = min(-(-total_tokens // self._ps), cap)
+        slack = max(0, slack)
+        cap = -(-(r["plen"] + r["mnew"] + slack) // self._ps)
+        need = min(-(-(total_tokens + slack) // self._ps), cap)
         cur = len(r["pages"])
         if need <= cur:
             return 0

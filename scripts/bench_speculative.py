@@ -1,25 +1,32 @@
-"""N-gram speculative decoding A/B on the chip (VERDICT r4 next #7).
+"""N-gram speculative decoding A/B on the chip (VERDICT r4 next #7;
+PR 10 added the continuous-engine arm).
 
 Decode at 1B int8 is bandwidth-bound (see profile_decode.py: the
 weight read alone floors the step), so accepted draft tokens are
 nearly free — each verify step reads the weights once for up to
 speculative_k+1 emitted tokens.  This script measures the real
-multiplier on the simple engine at the ppo1b rollout shape.
+multiplier on BOTH engines at the ppo1b rollout shape, from one
+script so dense-vs-continuous numbers are directly comparable.
 
-Arms: speculative_k in {0, 4, 8} × {greedy, temperature=1}.
+Arms: speculative_k in {0, 4, 8} × {greedy, temperature=1} on the
+simple (dense-cache) engine, then {0, 4} × the same temps on the
+ContinuousBatchingEngine — the SAME prompts and budgets pushed
+through submit()/step() (per-slot draft/verify over the paged pool,
+adaptive k OFF so the arm measures the verify path itself).
 Workload: random prompts (the worst case for prompt-lookup drafting —
 acceptance relies entirely on the model's own output falling into
 n-gram cycles, which random-weight models do produce; real code/math
 text accepts far more).
 
-Metric: wall-clock of engine.generate (one fused dispatch each — the
-tunnel RTT cancels in the ratio), tokens/s, and at temp=0 the
-fraction of rows whose tokens match the k=0 arm.  Bit-identity only
-holds at f32-highest (the CPU parity suite); on-chip, bf16
-accumulation differs across program shapes and near-tie argmaxes
-flip, so LOW agreement on random weights is expected, not a bug —
-the spec path stays self-consistent (tokens verified against, and
-logprobs read from, its own chunk forward).
+Metric: wall-clock (one fused dispatch for the dense engine; the wave
+loop for the continuous one), tokens/s, and at temp=0 the fraction of
+rows whose tokens match the k=0 arm.  Bit-identity only holds at
+f32-highest (the CPU parity suite); on-chip, bf16 accumulation
+differs across program shapes and near-tie argmaxes flip, so LOW
+agreement on random weights is expected, not a bug — the spec path
+stays self-consistent (tokens verified against, and logprobs read
+from, its own chunk forward).  Emits ONE bench.py-style JSON line at
+the end (continuous spec-on tok/s as the headline value).
 
 Run: python scripts/bench_speculative.py
 Env: SPEC_B (32), SPEC_P (256), SPEC_T (128), SPEC_REPS (3).
@@ -53,8 +60,11 @@ REPS = int(os.environ.get("SPEC_REPS", "3"))
 
 
 def main():
+    import json
+
     from orion_tpu.config import ModelConfig, RolloutConfig
     from orion_tpu.models import Transformer, init_params
+    from orion_tpu.rollout.continuous import ContinuousBatchingEngine
     from orion_tpu.rollout.engine import RolloutEngine
 
     mc = ModelConfig.pythia_1b()
@@ -65,6 +75,10 @@ def main():
     rs = np.random.RandomState(0)
     prompts = jnp.asarray(rs.randint(2, mc.vocab_size, (B, P)), jnp.int32)
     lens = jnp.full((B,), P, jnp.int32)
+    out = {"metric": "speculative decode A/B generated tokens/sec "
+                     f"(pythia-1b int8, B={B} P={P} T={T}, "
+                     f"{jax.default_backend()})",
+           "unit": "tokens/sec"}
 
     print(f"[spec-decode A/B] backend={jax.devices()[0].platform} "
           f"pythia-1b int8, B={B} P={P} T={T}", flush=True)
@@ -104,8 +118,55 @@ def main():
                     agree = f"  [rows matching k=0: {m:.0%}]"
             best = min(times)
             n_tok = B * T
-            print(f"  temp={temp:.0f} k={k}: {best*1e3:7.1f} ms  "
+            out[f"dense_t{temp:.0f}_k{k}_toks_per_sec"] = round(
+                n_tok / best, 1)
+            print(f"  dense temp={temp:.0f} k={k}: {best*1e3:7.1f} ms  "
                   f"({n_tok/best:6.0f} tok/s){agree}", flush=True)
+
+    # -- continuous-engine arm (PR 10): SAME prompts/budgets through
+    #    the submit()/step() service loop; adaptive k OFF so the arm
+    #    measures the per-slot paged verify path itself -------------
+    prompts_h = np.asarray(prompts)
+    for temp in (0.0, 1.0):
+        for k in (0, 4):
+            cont = ContinuousBatchingEngine(
+                model, mc,
+                RolloutConfig(max_prompt_len=P, max_new_tokens=T,
+                              temperature=temp, quantize_weights=True,
+                              max_batch_size=B, segment_len=16,
+                              speculative_k=k, spec_adaptive=False),
+                eos_token_id=None, pad_token_id=0)
+            cont.load_weights(params)
+
+            def serve(key):
+                cont.reset_rng(jax.random.key(key))
+                for i in range(B):
+                    cont.submit(key * 1000 + i, prompts_h[i], budget=T)
+                done = 0
+                while cont.pending:
+                    done += len(cont.step())
+                return done
+
+            serve(1)  # compile the wave programs
+            times = []
+            for rep in range(REPS):
+                t0 = time.perf_counter()
+                serve(2 + rep)
+                times.append(time.perf_counter() - t0)  # orion: ignore[naked-timer, bench-no-block] bench wall window; serve()'s step() loop drains every completion to host
+            best = min(times)
+            st = cont.server_stats()
+            acc = (st["spec_accepted"] / st["spec_drafted"]
+                   if st["spec_drafted"] else 0.0)
+            out[f"cont_t{temp:.0f}_k{k}_toks_per_sec"] = round(
+                B * T / best, 1)
+            if k:
+                out[f"cont_t{temp:.0f}_k{k}_accept_rate"] = round(acc, 3)
+            print(f"  cont  temp={temp:.0f} k={k}: {best*1e3:7.1f} ms  "
+                  f"({B*T/best:6.0f} tok/s)"
+                  + (f"  [accept {acc:.2f}]" if k else ""), flush=True)
+
+    out["value"] = out["cont_t0_k4_toks_per_sec"]
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

@@ -118,6 +118,32 @@ def test_priority_and_deadline_policies(mk):
     assert [a[0] for a in s.admit()] == [1]
 
 
+@pytest.mark.parametrize("sched", _impls(),
+                         ids=lambda s: type(s).__name__)
+def test_extend_speculative_slack(sched):
+    """PR 10 contract: ``extend(id, total, slack)`` reserves ``slack``
+    draft positions past the growth target AND past the lifetime cap
+    (the verify chunk may probe past the budget; those writes land in
+    reserved-but-never-attended slack).  Slack pages are ordinary
+    pages: rolled-back (rejected) drafts are overwritten in place, and
+    everything frees at finish."""
+    sched.add(1, 6, 6)                 # cap without slack: 3 pages
+    sched.admit()
+    assert len(sched.pages(1)) == 2    # prompt(6)+1 -> 2 pages at admit
+    # slack stretches the request's coverage: 6 content + 4 slack ->
+    # ceil(10/4) = 3 pages (one more than the no-slack need)
+    assert sched.extend(1, 6, 4) == 1
+    assert len(sched.pages(1)) == 3
+    # and the lifetime cap itself stretches: plen+mnew+slack = 16 ->
+    # 4 pages, where the no-slack cap would stop at 3
+    assert sched.extend(1, 999, 4) == 1
+    assert len(sched.pages(1)) == 4
+    # no-slack call against the grown table: already covered
+    assert sched.extend(1, 12, 0) == 0
+    assert sched.finish(1) == 4        # slack pages free with the rest
+    assert sched.free_pages == 16
+
+
 @pytest.mark.parametrize("mk", [PyScheduler, Scheduler])
 def test_watermark_holds_back_pages(mk):
     """Admission keeps `watermark` pages in reserve for in-flight
@@ -183,9 +209,12 @@ def test_prefix_cache_lru_eviction(mk):
 
 
 def _drive(a, b, seed, policy, max_k=4, n_ops=700):
-    """Randomized step-for-step cross-check of the full PR 8 contract:
-    solo + group adds with priorities/deadlines/prefix hashes, admit,
-    extend, preempt, finish, clear_cache."""
+    """Randomized step-for-step cross-check of the full PR 8 contract
+    (solo + group adds with priorities/deadlines/prefix hashes, admit,
+    extend, preempt, finish, clear_cache) extended with PR 10's
+    speculative extents: extends carry a random verify slack, and the
+    preempt op doubles as the rollback path (slack pages free with the
+    rest, requeue at arrival order)."""
     rng = random.Random(seed)
     hash_pool = [int(rng.getrandbits(62)) for _ in range(14)]
     live, next_id = [], 0
@@ -217,7 +246,8 @@ def _drive(a, b, seed, policy, max_k=4, n_ops=700):
         elif op < 0.75 and live:
             rid = rng.choice(live)
             t = rng.randint(1, 70)
-            assert a.extend(rid, t) == b.extend(rid, t)
+            slack = rng.choice([0, 0, 2, 4, 8])
+            assert a.extend(rid, t, slack) == b.extend(rid, t, slack)
             assert a.pages(rid) == b.pages(rid)
         elif op < 0.92 and live:
             rid = live.pop(rng.randrange(len(live)))
