@@ -19,16 +19,24 @@ The repo self-gates: ``tests/test_analysis.py`` runs this engine over
 """
 
 from orion_tpu.analysis.engine import (Finding, analyze_file, analyze_paths,
-                                       analyze_source, iter_python_files)
-from orion_tpu.analysis.report import format_findings
+                                       analyze_source, analyze_sources,
+                                       iter_python_files)
+from orion_tpu.analysis.project import PROJECT_RULES, ProjectContext
+from orion_tpu.analysis.report import (format_findings, format_json,
+                                       format_sarif)
 from orion_tpu.analysis.rules import RULES
 
 __all__ = [
     "Finding",
+    "PROJECT_RULES",
+    "ProjectContext",
     "RULES",
     "analyze_file",
     "analyze_paths",
     "analyze_source",
+    "analyze_sources",
     "format_findings",
+    "format_json",
+    "format_sarif",
     "iter_python_files",
 ]

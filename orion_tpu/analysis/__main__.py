@@ -1,5 +1,13 @@
 """CLI: ``python -m orion_tpu.analysis <paths>`` — nonzero exit on any
-unsuppressed finding, so scripts/lint.sh and CI can gate on it."""
+unsuppressed, un-baselined finding, so scripts/lint.sh and CI can gate
+on it.
+
+CI-grade surface: ``--format json|sarif`` for machine consumers,
+``--baseline FILE`` (+ ``--update-baseline``) so a new project rule can
+land warn-first and tighten later, and a content-hash result cache
+(on by default; ``--no-cache`` bypasses, ``--cache PATH`` relocates)
+that keeps repeated runs fast as the tree grows.
+"""
 
 from __future__ import annotations
 
@@ -7,8 +15,11 @@ import argparse
 import sys
 from typing import List, Optional
 
-from orion_tpu.analysis.engine import analyze_paths
-from orion_tpu.analysis.report import format_findings, format_rule_table
+from orion_tpu.analysis.engine import analyze_paths, default_cache_path
+from orion_tpu.analysis.report import (apply_baseline, format_findings,
+                                       format_json, format_rule_table,
+                                       format_sarif, load_baseline,
+                                       write_baseline)
 from orion_tpu.analysis.rules import RULES
 
 
@@ -16,14 +27,37 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m orion_tpu.analysis",
         description="JAX/TPU-aware static analysis for the orion-tpu "
-                    "tree (AST-based, stdlib-only)")
+                    "tree (AST-based, stdlib-only): per-file rules + "
+                    "project-wide rules over the whole parsed tree")
     parser.add_argument("paths", nargs="*",
                         help="files or directories to analyze")
     parser.add_argument("--list-rules", action="store_true",
-                        help="print the rule table and exit")
+                        help="print the rule table ([file] vs "
+                             "[project]) and exit")
     parser.add_argument("--rule", action="append", default=None,
                         metavar="RULE-ID",
                         help="run only these rules (repeatable)")
+    parser.add_argument("--no-project", action="store_true",
+                        help="report per-file findings only — the "
+                             "project rules judge the WHOLE tree, so "
+                             "a partial-path run (one file, one "
+                             "subdir) would flag every knob whose "
+                             "reader lives outside the analyzed set")
+    parser.add_argument("--format", choices=("text", "json", "sarif"),
+                        default="text", dest="fmt",
+                        help="report format (default: text)")
+    parser.add_argument("--baseline", metavar="FILE", default=None,
+                        help="JSON baseline of tolerated findings: "
+                             "only NEW findings gate (warn-first "
+                             "landing for new rules)")
+    parser.add_argument("--update-baseline", action="store_true",
+                        help="write the current findings to --baseline "
+                             "and exit 0")
+    parser.add_argument("--cache", metavar="FILE", default=None,
+                        help="result-cache location (default: "
+                             "~/.cache/orion-tpu-analysis-<cwd>.json)")
+    parser.add_argument("--no-cache", action="store_true",
+                        help="disable the per-file result cache")
     args = parser.parse_args(argv)
 
     if args.list_rules:
@@ -32,6 +66,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     if not args.paths:
         parser.error("no paths given (try: python -m orion_tpu.analysis "
                      "orion_tpu tests scripts)")
+    if args.update_baseline and not args.baseline:
+        parser.error("--update-baseline requires --baseline FILE")
 
     rules = None
     if args.rule:
@@ -41,16 +77,80 @@ def main(argv: Optional[List[str]] = None) -> int:
             parser.error(f"unknown rule id(s): {', '.join(unknown)} "
                          "(--list-rules shows the registry)")
         rules = [known[r] for r in args.rule]
+    if args.no_project:
+        # A report-level filter, not an execution filter: the engine
+        # still runs the project phase (it is cheap) so that the
+        # unused-suppression sweep can correctly judge suppressions of
+        # project-rule ids — only the project FINDINGS are withheld.
+        base = rules if rules is not None else list(RULES)
+        rules = [r for r in base
+                 if getattr(r, "kind", "file") != "project"]
+        if not rules:
+            parser.error("--no-project removed every requested rule "
+                         "(the --rule selection names only project "
+                         "rules) — a run that checks nothing must not "
+                         "report clean")
 
+    cache_path = None if args.no_cache else \
+        (args.cache or default_cache_path())
     try:
-        findings = analyze_paths(args.paths, rules=rules)
+        findings = analyze_paths(args.paths, rules=rules,
+                                 cache_path=cache_path)
     except FileNotFoundError as e:
         print(str(e), file=sys.stderr)
         return 2
-    if findings:
-        print(format_findings(findings))
-        return 1
-    return 0
+
+    if args.update_baseline:
+        try:
+            write_baseline(args.baseline, findings)
+        except OSError as e:
+            # mistyped path / unwritable dir: a usage error (exit 2),
+            # not a traceback CI reads as "findings found"
+            print(f"cannot write baseline {args.baseline}: {e}",
+                  file=sys.stderr)
+            return 2
+        # count what the file actually holds: write_baseline excludes
+        # syntax-error findings (unparsable files always gate)
+        n = sum(1 for f in findings if f.rule_id != "syntax-error")
+        skipped = len(findings) - n
+        msg = (f"baseline written: {args.baseline} "
+               f"({n} finding{'s' if n != 1 else ''}"
+               + (f"; {skipped} syntax-error finding"
+                  f"{'s' if skipped != 1 else ''} not baselined"
+                  if skipped else "") + ")")
+        # machine formats keep stdout parseable — the status line goes
+        # to stderr there
+        print(msg, file=sys.stderr if args.fmt != "text" else
+              sys.stdout)
+        return 0
+
+    baselined: List = []
+    if args.baseline:
+        try:
+            known_keys = load_baseline(args.baseline)
+        except FileNotFoundError:
+            print(f"baseline file not found: {args.baseline} "
+                  "(create it with --update-baseline)", file=sys.stderr)
+            return 2
+        except (OSError, ValueError, KeyError, TypeError) as e:
+            # bad JSON (ValueError) or a hand-edited entry missing
+            # rule/path/message (KeyError/TypeError): a usage error
+            # CI must distinguish from "findings found"
+            print(f"unreadable baseline {args.baseline}: {e!r}",
+                  file=sys.stderr)
+            return 2
+        findings, baselined = apply_baseline(findings, known_keys,
+                                             args.baseline)
+
+    if args.fmt == "json":
+        print(format_json(findings, baselined=len(baselined)))
+    elif args.fmt == "sarif":
+        print(format_sarif(findings, rules=rules or RULES))
+    elif findings or baselined:
+        out = format_findings(findings, baselined=len(baselined))
+        if out:
+            print(out)
+    return 1 if findings else 0
 
 
 if __name__ == "__main__":

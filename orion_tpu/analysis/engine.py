@@ -1,24 +1,52 @@
-"""Engine: file walking, suppression comments, rule dispatch.
+"""Engine: file walking, suppression comments, two-phase rule dispatch.
 
-Rules are pure functions ``(Module ast, ModuleContext) -> [Finding]``
-registered in :mod:`orion_tpu.analysis.rules`.  The engine owns
-everything rule authors should not re-implement: reading files, parsing,
-the import-alias map (so a rule matches ``jax.random.split`` whether the
-file wrote ``jax.random.split``, ``random.split`` or ``jrandom.split``),
-and per-line ``# orion: ignore[rule-id]`` suppression.
+Phase 1 parses every file into a :class:`ModuleContext` and runs the
+per-file rules — pure functions ``(ModuleContext) -> [Finding]``
+registered in :mod:`orion_tpu.analysis.rules`.  Phase 2 hands ALL the
+parsed modules to the **project rules** (:mod:`orion_tpu.analysis.
+project`) as one :class:`~orion_tpu.analysis.project.ProjectContext` —
+the cross-file bug classes (lock discipline, wire-frame exhaustiveness,
+config drift) are invisible to any single module's AST.
+
+The engine owns everything rule authors should not re-implement:
+reading files, parsing, the import-alias map (so a rule matches
+``jax.random.split`` whether the file wrote ``jax.random.split``,
+``random.split`` or ``jrandom.split``), per-line ``# orion:
+ignore[rule-id]`` suppression, the ``unused-suppression`` sweep (a
+suppression whose rule no longer fires is itself a finding), and the
+content-hash result cache that keeps ``scripts/lint.sh`` fast as the
+tree grows (per-file rule results are cached, validated by content
+sha1 alone — stat is never trusted; the project phase is global and
+always runs fresh).
 """
 
 from __future__ import annotations
 
 import ast
 import dataclasses
+import hashlib
+import io
+import json
 import os
 import re
-from typing import Dict, Iterator, List, Optional, Sequence
+import tokenize
+from typing import Dict, Iterator, List, Optional, Sequence, Set, Tuple
 
 _SUPPRESS_RE = re.compile(
     r"#\s*orion:\s*ignore(?:\[(?P<ids>[a-z0-9_,\s-]+)\])?")
-_MISS = object()
+
+
+def is_test_path(path: str) -> bool:
+    """Shared test-file predicate (naked-timer exemption, the
+    config-drift usage universe, test-defined config classes) — ONE
+    definition so the exemption and universe sides cannot drift.
+    Matches a whole ``tests`` path SEGMENT, not the substring: a
+    product dir merely ending in "tests" (``backtests/``) is not test
+    code."""
+    parts = path.replace(os.sep, "/").split("/")
+    base = parts[-1]
+    return ("tests" in parts[:-1] or base.startswith("test_")
+            or base == "conftest.py")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -44,7 +72,15 @@ class ModuleContext:
         self.tree = tree
         self.aliases = _collect_aliases(tree)
         self._nodes: Optional[List[ast.AST]] = None
-        self._dotted_cache: Dict[int, Optional[str]] = {}
+        # dotted-name cache: id(node) -> (node, resolved).  The entry
+        # KEEPS the node alive and the hit path identity-checks it —
+        # id() alone is unsound: rules that re-parse snippets create
+        # short-lived trees whose freed node ids CPython recycles, and
+        # a recycled id must never serve another node's cached name.
+        # The cache lives and dies with this context (= with its tree).
+        self._dotted_cache: Dict[int, Tuple[ast.AST, Optional[str]]] = {}
+        self._suppress_cache: Optional[
+            Dict[int, Optional[Set[str]]]] = None
 
     def walk(self) -> List[ast.AST]:
         """Every node of the module, cached — eight rules re-walking
@@ -59,11 +95,11 @@ class ModuleContext:
         expanded: with ``import jax.numpy as jnp``, the expression
         ``jnp.max`` resolves to ``"jax.numpy.max"``.  ``self.foo``
         resolves to ``"self.foo"``.  None for non-name expressions."""
-        cached = self._dotted_cache.get(id(node), _MISS)
-        if cached is not _MISS:
-            return cached
+        hit = self._dotted_cache.get(id(node))
+        if hit is not None and hit[0] is node:
+            return hit[1]
         out = self._dotted_uncached(node)
-        self._dotted_cache[id(node)] = out
+        self._dotted_cache[id(node)] = (node, out)
         return out
 
     def _dotted_uncached(self, node: ast.AST) -> Optional[str]:
@@ -82,15 +118,50 @@ class ModuleContext:
         return ".".join(reversed(parts))
 
     def is_suppressed(self, finding: Finding) -> bool:
-        if not 1 <= finding.line <= len(self.lines):
+        # Driven off the SAME tokenized comment map the
+        # unused-suppression sweep audits: a marker inside a string
+        # literal (docstring example, hint template) is prose — it
+        # neither suppresses nor can be judged stale.
+        comments = self._suppress_map()
+        if finding.line not in comments:
             return False
-        m = _SUPPRESS_RE.search(self.lines[finding.line - 1])
-        if m is None:
-            return False
-        ids = m.group("ids")
+        ids = comments[finding.line]
         if ids is None:
-            return True  # bare ``# orion: ignore`` silences every rule
-        return finding.rule_id in {s.strip() for s in ids.split(",")}
+            # A bracketless ignore silences every rule EXCEPT the
+            # staleness verdict on itself — otherwise a stale bare
+            # ignore could never be reported (it would suppress its
+            # own unused-suppression finding on the same line).
+            return finding.rule_id != "unused-suppression"
+        return finding.rule_id in ids
+
+    def _suppress_map(self) -> Dict[int, Optional[Set[str]]]:
+        if self._suppress_cache is None:
+            self._suppress_cache = self.suppression_comments()
+        return self._suppress_cache
+
+    def suppression_comments(self) -> Dict[int, Optional[Set[str]]]:
+        """line -> suppressed rule ids (None = bracketless, silences
+        everything), from REAL comment tokens only — the marker inside
+        a string literal (a docstring example, a hint template) is
+        prose, not a suppression the unused-suppression sweep should
+        judge."""
+        out: Dict[int, Optional[Set[str]]] = {}
+        try:
+            tokens = tokenize.generate_tokens(
+                io.StringIO(self.source).readline)
+            for tok in tokens:
+                if tok.type != tokenize.COMMENT:
+                    continue
+                m = _SUPPRESS_RE.search(tok.string)
+                if m is None:
+                    continue
+                ids = m.group("ids")
+                out[tok.start[0]] = (
+                    None if ids is None else
+                    {s.strip() for s in ids.split(",") if s.strip()})
+        except (tokenize.TokenError, IndentationError, SyntaxError):
+            pass  # unparsable tail: phase 1 already reported it
+        return out
 
 
 def _collect_aliases(tree: ast.Module) -> Dict[str, str]:
@@ -111,32 +182,324 @@ def _collect_aliases(tree: ast.Module) -> Dict[str, str]:
     return aliases
 
 
-def analyze_source(source: str, path: str = "<string>",
-                   rules: Optional[Sequence] = None,
-                   keep_suppressed: bool = False) -> List[Finding]:
-    """Run rules over one source blob.  Returns unsuppressed findings
-    sorted by (line, rule)."""
-    from orion_tpu.analysis.rules import RULES
+# ---------------------------------------------------------------------------
+# rule-set plumbing
+# ---------------------------------------------------------------------------
 
+
+def _registry():
+    from orion_tpu.analysis.rules import RULES
+    return RULES
+
+
+def _split_rules(rules: Optional[Sequence]):
+    """Resolve the requested rule set into (per-file rules, project
+    rules, run-unused-sweep, report-filter-ids).
+
+    The unused-suppression sweep can only judge a line against rules
+    that actually RAN, so requesting it (or no filter at all) runs the
+    full registry and filters the report instead."""
+    registry = _registry()
+    if rules is None:
+        effective = registry
+        report_ids = None
+    else:
+        report_ids = {r.id for r in rules}
+        effective = (registry if "unused-suppression" in report_ids
+                     else list(rules))
+    file_rules = [r for r in effective
+                  if getattr(r, "kind", "file") == "file"]
+    project_rules = [r for r in effective
+                     if getattr(r, "kind", "file") == "project"]
+    run_unused = rules is None or "unused-suppression" in report_ids
+    return file_rules, project_rules, run_unused, report_ids
+
+
+def _run_file_rules(ctx: ModuleContext, file_rules) -> List[Finding]:
+    out: List[Finding] = []
+    for rule in file_rules:
+        if rule.id == "unused-suppression":
+            continue  # engine pass, not an AST checker
+        out.extend(rule.check(ctx))
+    return out
+
+
+def _unused_suppressions(ctx: ModuleContext,
+                         fired_by_line: Dict[int, Set[str]]
+                         ) -> Iterator[Finding]:
+    # _suppress_map: the same memoized tokenization is_suppressed uses
+    # (tokenizing every module twice per run was pure duplicated work)
+    for line, ids in sorted(ctx._suppress_map().items()):
+        fired = fired_by_line.get(line, set())
+        if ids is None:
+            if not fired:
+                yield Finding(
+                    "unused-suppression", ctx.path, line,
+                    "bracketless '# orion: ignore' comment but no rule "
+                    "fires on this line",
+                    hint="delete the stale suppression (or scope it "
+                         "with [rule-id] if it guards a future rule)")
+            continue
+        for rid in sorted(ids):
+            if rid == "unused-suppression":
+                continue  # cannot judge itself
+            if rid not in fired:
+                yield Finding(
+                    "unused-suppression", ctx.path, line,
+                    f"suppression for {rid!r} but that rule does not "
+                    "fire on this line",
+                    hint="delete the stale suppression — a dead ignore "
+                         "hides the NEXT real finding on this line "
+                         "(ruff unused-noqa semantics)")
+
+
+def _finalize(findings: List[Finding],
+              contexts: Dict[str, ModuleContext],
+              keep_suppressed: bool,
+              report_ids: Optional[Set[str]]) -> List[Finding]:
+    seen = set()
+    out: List[Finding] = []
+    for f in sorted(findings,
+                    key=lambda f: (f.path, f.line, f.rule_id, f.message)):
+        # syntax-error passes every --rule filter: a rule-filtered
+        # gate must never report clean on a file it could not parse
+        if report_ids is not None and f.rule_id not in report_ids \
+                and f.rule_id != "syntax-error":
+            continue
+        ctx = contexts.get(f.path)
+        if not keep_suppressed and ctx is not None and \
+                ctx.is_suppressed(f):
+            continue
+        if f.key() not in seen:
+            seen.add(f.key())
+            out.append(f)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# result cache
+# ---------------------------------------------------------------------------
+
+
+class ResultCache:
+    """Per-file rule results keyed by content hash.
+
+    Every run still reads and parses every file (the project phase is
+    global by definition), so the source bytes are in hand either way
+    and hashing them is ~free; what the cache skips is the expensive
+    part — running every per-file rule over every unchanged module.
+    Validity is deliberately the CONTENT hash alone, never the stat:
+    a ``touch`` stays a hit, an edit that preserves mtime+size still
+    invalidates, and a stat fast-path would buy nothing since the read
+    already happened.  The whole cache is discarded when the analysis
+    package itself (or the active rule set) changes — a rule edit must
+    re-lint the world."""
+
+    def __init__(self, path: str, fingerprint: str):
+        self.path = path
+        self.fingerprint = fingerprint
+        self.hits = 0
+        self.misses = 0
+        # One file holds a SECTION per rule-set fingerprint (bounded),
+        # so alternating full-registry and --rule invocations coexist
+        # instead of wholesale-evicting each other's entries.
+        self._sections: Dict[str, Dict[str, dict]] = {}
+        try:
+            with open(path, "r", encoding="utf-8") as fh:
+                data = json.load(fh)
+            sections = data.get("sections")
+            if isinstance(sections, dict):
+                # drop corrupt (non-dict) sections at load so they
+                # neither crash get()/put() nor round-trip via save()
+                self._sections = {k: v for k, v in sections.items()
+                                  if isinstance(v, dict)}
+        except (OSError, ValueError, AttributeError):
+            pass
+        self._files: Dict[str, dict] = self._sections.get(
+            fingerprint) or {}
+        self._dirty = False
+
+    @staticmethod
+    def _entry_key(path: str) -> str:
+        # Keyed by the invocation SPELLING, not abspath: several rules
+        # are path-dependent (is_test_path, the obs/ and remote.py
+        # exemptions judge the string), so `orion_tpu/obs/t.py` from
+        # the repo root and `obs/t.py` from inside the package are
+        # different analyses of the same bytes — a shared cache must
+        # never serve one spelling's verdict for the other.
+        return path.replace(os.sep, "/")
+
+    def get(self, path: str, sha1: str) -> Optional[List[Finding]]:
+        entry = self._files.get(self._entry_key(path))
+        try:
+            if not isinstance(entry, dict) or entry.get("sha1") != sha1:
+                self.misses += 1
+                return None
+            out = [Finding(str(row[0]), path, int(row[2]),
+                           str(row[3]), str(row[4]))
+                   for row in entry["findings"]]
+        except (KeyError, IndexError, TypeError, ValueError):
+            # malformed entry (hand edit, disk corruption): the cache
+            # is best-effort — degrade to a miss, never a traceback
+            self.misses += 1
+            return None
+        self.hits += 1
+        return out
+
+    def put(self, path: str, sha1: str,
+            findings: List[Finding]) -> None:
+        self._dirty = True
+        self._files[self._entry_key(path)] = {
+            "sha1": sha1,
+            "findings": [[f.rule_id, f.path, f.line, f.message, f.hint]
+                         for f in findings]}
+
+    def prune(self, keep_paths) -> None:
+        """Bound section growth: renamed/deleted files and one-off
+        scratch paths must not accumulate forever — but an ad-hoc
+        single-file run must NOT wipe the full-tree section either, so
+        un-analyzed entries are only shed once the section exceeds a
+        generous bound (insertion order ≈ oldest first)."""
+        keep = {self._entry_key(p) for p in keep_paths}
+        bound = max(1024, 2 * len(keep))
+        if len(self._files) <= bound:
+            return
+        for k in list(self._files):
+            if len(self._files) <= bound:
+                break
+            if k not in keep:
+                del self._files[k]
+                self._dirty = True
+
+    def save(self) -> None:
+        if not self._dirty:
+            return  # fully-hit run: nothing changed, skip the rewrite
+        tmp = f"{self.path}.tmp.{os.getpid()}"
+        # re-insert last so the active section is the freshest, then
+        # bound growth (stale fingerprints — e.g. pre-edit package
+        # hashes — age out oldest-first)
+        self._sections.pop(self.fingerprint, None)
+        self._sections[self.fingerprint] = self._files
+        while len(self._sections) > 4:
+            self._sections.pop(next(iter(self._sections)))
+        try:
+            os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+            with open(tmp, "w", encoding="utf-8") as fh:
+                json.dump({"sections": self._sections}, fh)
+            os.replace(tmp, self.path)
+        except OSError:  # read-only FS etc.: the cache is best-effort
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+
+
+def ruleset_fingerprint(rules: Optional[Sequence] = None) -> str:
+    """Hash of the analysis package sources + the active rule ids: any
+    rule/engine edit (or a different ``--rule`` selection) invalidates
+    every cached result."""
+    h = hashlib.sha1()
+    pkg = os.path.dirname(os.path.abspath(__file__))
+    for name in sorted(os.listdir(pkg)):
+        if name.endswith(".py"):
+            with open(os.path.join(pkg, name), "rb") as fh:
+                h.update(fh.read())
+    for r in sorted((rules if rules is not None else _registry()),
+                    key=lambda r: r.id):
+        # sorted: `--rule a --rule b` and `--rule b --rule a` are the
+        # same selection and must share one cache section
+        h.update(r.id.encode())
+    return h.hexdigest()
+
+
+def default_cache_path() -> str:
+    """Outside the tree (the gate must never lint its own cache) and
+    per working directory, so sibling checkouts do not fight."""
+    tag = hashlib.sha1(os.getcwd().encode()).hexdigest()[:12]
+    return os.path.join(os.path.expanduser("~"), ".cache",
+                        f"orion-tpu-analysis-{tag}.json")
+
+
+# ---------------------------------------------------------------------------
+# analysis entry points
+# ---------------------------------------------------------------------------
+
+
+def _parse(source: str, path: str):
+    """(ModuleContext, None) or (None, syntax Finding)."""
     try:
         tree = ast.parse(source, filename=path)
     except SyntaxError as e:
-        return [Finding("syntax-error", path, e.lineno or 1,
-                        f"file does not parse: {e.msg}",
-                        hint="fix the syntax error first")]
-    ctx = ModuleContext(path, source, tree)
-    out: List[Finding] = []
-    for rule in (RULES if rules is None else rules):
-        for f in rule.check(ctx):
-            if keep_suppressed or not ctx.is_suppressed(f):
-                out.append(f)
-    seen = set()
-    uniq = []
-    for f in sorted(out, key=lambda f: (f.line, f.rule_id, f.message)):
-        if f.key() not in seen:
-            seen.add(f.key())
-            uniq.append(f)
-    return uniq
+        return None, Finding("syntax-error", path, e.lineno or 1,
+                             f"file does not parse: {e.msg}",
+                             hint="fix the syntax error first")
+    return ModuleContext(path, source, tree), None
+
+
+def _analyze_modules(sources: List[Tuple[str, str]],
+                     rules: Optional[Sequence],
+                     keep_suppressed: bool = False,
+                     cache: Optional[ResultCache] = None
+                     ) -> List[Finding]:
+    """The full two-phase pipeline over (path, source) pairs."""
+    file_rules, project_rules, run_unused, report_ids = \
+        _split_rules(rules)
+
+    contexts: Dict[str, ModuleContext] = {}
+    raw: List[Finding] = []
+    ordered_ctx: List[ModuleContext] = []
+    for path, source in sources:
+        ctx, err = _parse(source, path)
+        if err is not None:
+            raw.append(err)
+            continue
+        contexts[path] = ctx
+        ordered_ctx.append(ctx)
+        per_file: Optional[List[Finding]] = None
+        sha1 = None
+        if cache is not None:
+            sha1 = hashlib.sha1(source.encode()).hexdigest()
+            per_file = cache.get(path, sha1)
+        if per_file is None:
+            per_file = _run_file_rules(ctx, file_rules)
+            if cache is not None:
+                cache.put(path, sha1, per_file)
+        raw.extend(per_file)
+
+    if project_rules and ordered_ctx:
+        from orion_tpu.analysis.project import ProjectContext
+        project = ProjectContext(ordered_ctx)
+        for rule in project_rules:
+            raw.extend(rule.check_project(project))
+
+    if run_unused:
+        fired: Dict[str, Dict[int, Set[str]]] = {}
+        for f in raw:
+            fired.setdefault(f.path, {}).setdefault(
+                f.line, set()).add(f.rule_id)
+        for ctx in ordered_ctx:
+            raw.extend(_unused_suppressions(
+                ctx, fired.get(ctx.path, {})))
+
+    return _finalize(raw, contexts, keep_suppressed, report_ids)
+
+
+def analyze_source(source: str, path: str = "<string>",
+                   rules: Optional[Sequence] = None,
+                   keep_suppressed: bool = False) -> List[Finding]:
+    """Run both phases over one source blob (the project phase sees a
+    single-module project).  Returns unsuppressed findings sorted by
+    (line, rule)."""
+    return _analyze_modules([(path, source)], rules,
+                            keep_suppressed=keep_suppressed)
+
+
+def analyze_sources(sources: Sequence[Tuple[str, str]],
+                    rules: Optional[Sequence] = None) -> List[Finding]:
+    """Run both phases over in-memory ``(path, source)`` pairs as ONE
+    project — how the multi-module rule fixtures exercise cross-file
+    rules without touching disk."""
+    return _analyze_modules(list(sources), rules)
 
 
 def analyze_file(path: str, rules: Optional[Sequence] = None) -> \
@@ -149,13 +512,25 @@ def iter_python_files(paths: Sequence[str]) -> Iterator[str]:
     """Expand files/dirs into .py files, skipping caches and hidden
     dirs; deterministic order.  A nonexistent explicit path raises —
     a gate that silently skips a renamed file is worse than no gate."""
+    seen: set = set()
+
+    def emit(p: str) -> Iterator[str]:
+        # Dedupe by abspath: overlapping inputs (a dir plus a file
+        # inside it) must not enter the PROJECT phase twice — a
+        # duplicated class makes every method ambiguously owned and
+        # silently disables cross-module thread-entry resolution.
+        key = os.path.abspath(p)
+        if key not in seen:
+            seen.add(key)
+            yield p
+
     for p in paths:
         if not os.path.exists(p):
             raise FileNotFoundError(
                 f"orion_tpu.analysis: no such file or directory: {p}")
         if os.path.isfile(p):
             if p.endswith(".py"):
-                yield p
+                yield from emit(p)
             continue
         for root, dirs, files in os.walk(p):
             dirs[:] = sorted(d for d in dirs
@@ -163,12 +538,24 @@ def iter_python_files(paths: Sequence[str]) -> Iterator[str]:
                              and d != "__pycache__")
             for name in sorted(files):
                 if name.endswith(".py"):
-                    yield os.path.join(root, name)
+                    yield from emit(os.path.join(root, name))
 
 
 def analyze_paths(paths: Sequence[str],
-                  rules: Optional[Sequence] = None) -> List[Finding]:
-    out: List[Finding] = []
+                  rules: Optional[Sequence] = None,
+                  cache_path: Optional[str] = None) -> List[Finding]:
+    """Analyze files/directories; both phases.  ``cache_path`` enables
+    the per-file result cache (the CLI's default; library callers and
+    the test fixtures skip it)."""
+    cache = None
+    if cache_path:
+        cache = ResultCache(cache_path, ruleset_fingerprint(rules))
+    sources: List[Tuple[str, str]] = []
     for fp in iter_python_files(paths):
-        out.extend(analyze_file(fp, rules=rules))
-    return out
+        with open(fp, "r", encoding="utf-8") as fh:
+            sources.append((fp, fh.read()))
+    findings = _analyze_modules(sources, rules, cache=cache)
+    if cache is not None:
+        cache.prune([p for p, _ in sources])
+        cache.save()
+    return findings

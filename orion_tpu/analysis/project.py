@@ -1,0 +1,824 @@
+"""Phase 2: project-wide analysis over every parsed module at once.
+
+The per-file rules in :mod:`orion_tpu.analysis.rules` see one module at
+a time; the bug classes the PR 5-10 hardening rounds kept catching by
+hand are *cross-cutting*: an attribute a lock guards in nine methods
+and one background thread touches bare, a wire-frame constant a
+dispatch chain silently drops, a config knob nothing ever reads.  The
+engine parses every file into a :class:`~orion_tpu.analysis.engine.
+ModuleContext` (phase 1), then builds ONE :class:`ProjectContext` —
+module index, class/attribute maps, thread-entry-point discovery —
+that every **project rule** here consumes (phase 2).
+
+Project rules register with :func:`project_rule` into the same
+``RULES`` registry the CLI lists (``--list-rules`` marks them
+``[project]``); their findings attach to a concrete file:line and obey
+the same ``# orion: ignore[rule-id]`` suppression as per-file findings.
+
+Scope note: project rules see exactly the files the invocation names.
+The self-gate and ``scripts/lint.sh`` run the whole tree in one call —
+running a single subdirectory can legitimately report a config knob as
+orphaned when its only reader lives outside the analyzed set.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from orion_tpu.analysis.engine import (Finding, ModuleContext,
+                                       is_test_path)
+
+#: Registered project rules (populated by :func:`project_rule`); the
+#: combined registry lives in ``orion_tpu.analysis.rules.RULES``.
+PROJECT_RULES: List = []
+
+_LOCK_CTORS = {"threading.Lock", "threading.RLock", "threading.Condition"}
+
+
+def _assign_targets_value(node: ast.AST
+                          ) -> Tuple[List[ast.AST], Optional[ast.AST]]:
+    """(targets, value) for plain AND annotated assignments — a lock
+    declared ``self._lock: threading.Lock = threading.Lock()`` (or an
+    annotated ``_HEADER``/``PROTOCOL_VERSION``) must scan identically
+    to the bare form."""
+    if isinstance(node, ast.Assign):
+        return list(node.targets), node.value
+    if isinstance(node, ast.AnnAssign) and node.value is not None:
+        return [node.target], node.value
+    return [], None
+
+
+class ClassInfo:
+    """Project-level summary of one class definition: methods, declared
+    (annotated) fields, the ``self.*`` locks it owns, and which
+    condition variables alias which lock (``threading.Condition(
+    self._lock)`` acquires ``self._lock``)."""
+
+    def __init__(self, ctx: ModuleContext, node: ast.ClassDef):
+        self.ctx = ctx
+        self.node = node
+        self.name = node.name
+        self.bases = [ctx.dotted(b) or "" for b in node.bases]
+        self.is_dataclass = any(
+            (ctx.dotted(d) or ctx.dotted(getattr(d, "func", d)) or "")
+            .split(".")[-1] == "dataclass" for d in node.decorator_list)
+        self.methods: Dict[str, ast.AST] = {}
+        self.fields: Dict[str, ast.AnnAssign] = {}  # annotated fields
+        for stmt in node.body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.methods[stmt.name] = stmt
+            elif isinstance(stmt, ast.AnnAssign) and \
+                    isinstance(stmt.target, ast.Name):
+                self.fields[stmt.target.id] = stmt
+        # -- lock ownership: self.X = threading.Lock()/RLock(), and
+        # -- aliases: self.Y = threading.Condition(self.X) (bare
+        # -- Condition() wraps its own lock and counts as an owner).
+        self.lock_attrs: Set[str] = set()
+        self.lock_aliases: Dict[str, str] = {}
+        for sub in ast.walk(node):
+            targets, value = _assign_targets_value(sub)
+            if not isinstance(value, ast.Call):
+                continue
+            d = ctx.dotted(value.func)
+            if d not in _LOCK_CTORS:
+                continue
+            for t in targets:
+                name = self._self_attr(t)
+                if name is None:
+                    continue
+                arg = value.args[0] if value.args else None
+                if arg is None:
+                    for kw in value.keywords:
+                        if kw.arg == "lock":
+                            arg = kw.value
+                backing = self._self_attr(arg) if arg is not None else None
+                if d.endswith("Condition") and backing is not None:
+                    self.lock_aliases[name] = backing
+                else:
+                    self.lock_attrs.add(name)
+
+    @staticmethod
+    def _self_attr(node: Optional[ast.AST]) -> Optional[str]:
+        if isinstance(node, ast.Attribute) and \
+                isinstance(node.value, ast.Name) and \
+                node.value.id == "self":
+            return node.attr
+        return None
+
+    def held_lock(self, name: str) -> Optional[str]:
+        """Canonical lock attr acquired by ``with self.<name>:``."""
+        if name in self.lock_attrs:
+            return name
+        return self.lock_aliases.get(name)
+
+
+class ProjectContext:
+    """Everything phase 2 knows about the analyzed file set: the module
+    contexts, a class index, the project-wide ``FRAME_*`` constant
+    universe, and lazily-built attribute-usage maps."""
+
+    def __init__(self, modules: Sequence[ModuleContext]):
+        self.modules: List[ModuleContext] = list(modules)
+        self.by_path: Dict[str, ModuleContext] = {
+            m.path: m for m in self.modules}
+        self.classes: List[ClassInfo] = []
+        self.classes_by_name: Dict[str, List[ClassInfo]] = {}
+        #: FRAME_* name -> int value, across every analyzed module.
+        self.frame_constants: Dict[str, int] = {}
+        for m in self.modules:
+            for node in m.walk():
+                if isinstance(node, ast.ClassDef):
+                    info = ClassInfo(m, node)
+                    self.classes.append(info)
+                    self.classes_by_name.setdefault(
+                        info.name, []).append(info)
+                else:
+                    targets, value = _assign_targets_value(node)
+                    if isinstance(value, ast.Constant) and \
+                            isinstance(value.value, int):
+                        for t in targets:
+                            if isinstance(t, ast.Name) and \
+                                    t.id.startswith("FRAME_") and \
+                                    t.id.isupper():
+                                self.frame_constants[t.id] = value.value
+        self._usage_names: Optional[Set[str]] = None
+        self._thread_target_attrs: Optional[List[str]] = None
+        self._lock_method_owners: Optional[
+            Dict[str, List[ClassInfo]]] = None
+
+    # -- thread entry points -------------------------------------------
+    def thread_entries(self, info: ClassInfo) -> Set[str]:
+        """Method names of ``info`` that run on a non-creating thread:
+        ``threading.Thread(target=self.m)`` / ``Thread(target=x.m)``
+        anywhere in the project (``m`` must name a method of exactly
+        one lock-owning class for the cross-module form), plus any
+        bare ``self.m`` escaping as a call argument (registered
+        callbacks, signal handlers)."""
+        entries: Set[str] = set()
+        # in-class: Thread targets and callback escapes
+        for meth in info.methods.values():
+            for sub in ast.walk(meth):
+                if not isinstance(sub, ast.Call):
+                    continue
+                exprs = list(sub.args) + [k.value for k in sub.keywords]
+                for e in exprs:
+                    name = ClassInfo._self_attr(e)
+                    if name is None or name not in info.methods:
+                        continue
+                    # Thread(target=self.m) is the canonical entry;
+                    # any OTHER call a bound method escapes into is a
+                    # potential callback entry too (watchdog/signal/
+                    # atexit registration) — every e here IS a call
+                    # argument, so both arms admit it.
+                    entries.add(name)
+        # cross-module: Thread(target=obj.m) where m is unambiguous —
+        # both the project-wide target scan and the method-owner map
+        # are class-independent, so they are computed ONCE per project
+        # (the lock-discipline rule calls this per lock-owning class)
+        owners = self._method_owners()
+        for attr in self._thread_targets():
+            if attr in info.methods:
+                own = owners.get(attr, ())
+                if len(own) == 1 and own[0] is info:
+                    entries.add(attr)
+        return entries
+
+    def _thread_targets(self) -> List[str]:
+        """Attribute names appearing as ``threading.Thread(target=
+        <expr>.m)`` anywhere in the project (one walk, cached)."""
+        if self._thread_target_attrs is None:
+            out: List[str] = []
+            for m in self.modules:
+                for sub in m.walk():
+                    if not (isinstance(sub, ast.Call) and
+                            m.dotted(sub.func) == "threading.Thread"):
+                        continue
+                    for kw in sub.keywords:
+                        if kw.arg == "target" and \
+                                isinstance(kw.value, ast.Attribute):
+                            out.append(kw.value.attr)
+            self._thread_target_attrs = out
+        return self._thread_target_attrs
+
+    def _method_owners(self) -> Dict[str, List[ClassInfo]]:
+        """method name -> the lock-owning classes defining it (cached;
+        the cross-module Thread-target form only resolves names owned
+        by exactly one such class)."""
+        if self._lock_method_owners is None:
+            owners: Dict[str, List[ClassInfo]] = {}
+            for c in self.classes:
+                if not (c.lock_attrs or c.lock_aliases):
+                    continue
+                for name in c.methods:
+                    owners.setdefault(name, []).append(c)
+            self._lock_method_owners = owners
+        return self._lock_method_owners
+
+    # -- config-drift support ------------------------------------------
+    def config_classes(self) -> List[ClassInfo]:
+        # test-defined *Config dataclasses are scaffolding, not knobs
+        # the product must wire — they never enter the drift universe
+        return [c for c in self.classes
+                if c.is_dataclass and c.name.endswith("Config")
+                and not is_test_path(c.ctx.path)]
+
+    def usage_names(self) -> Set[str]:
+        """Attribute names read (plus getattr/hasattr string literals)
+        in every module that neither defines a config class nor is a
+        test — the "is this knob wired?" evidence set."""
+        if self._usage_names is not None:
+            return self._usage_names
+        defining = {c.ctx.path for c in self.config_classes()}
+        out: Set[str] = set()
+        for m in self.modules:
+            if m.path in defining or is_test_path(m.path):
+                continue
+            for node in m.walk():
+                if isinstance(node, ast.Attribute):
+                    # READS only: `cfg.knob = 5` in launch wiring is a
+                    # store — a knob that is set but never consumed is
+                    # exactly the drift this rule exists to catch
+                    if isinstance(getattr(node, "ctx", None), ast.Load):
+                        out.add(node.attr)
+                elif isinstance(node, ast.Call):
+                    d = m.dotted(node.func)
+                    if d in ("getattr", "hasattr") and \
+                            len(node.args) >= 2 and \
+                            isinstance(node.args[1], ast.Constant) and \
+                            isinstance(node.args[1].value, str):
+                        out.add(node.args[1].value)
+        self._usage_names = out
+        return out
+
+
+class ProjectRule:
+    kind = "project"
+
+    def __init__(self, rule_id: str, description: str, checker):
+        self.id = rule_id
+        self.description = description
+        self._checker = checker
+
+    def check_project(self, project: ProjectContext) -> List[Finding]:
+        return list(self._checker(project))
+
+
+def project_rule(rule_id: str, description: str):
+    def deco(fn):
+        PROJECT_RULES.append(ProjectRule(rule_id, description, fn))
+        return fn
+    return deco
+
+
+# ---------------------------------------------------------------------------
+# project rule: lock-discipline
+# ---------------------------------------------------------------------------
+
+_NO_LOCKS: frozenset = frozenset()
+
+
+def _method_accesses(info: ClassInfo, meth: ast.AST
+                     ) -> Tuple[List[Tuple[str, int, frozenset]],
+                                List[Tuple[str, frozenset]]]:
+    """One method's ``self.*`` state accesses and method-call sites:
+    ``([(attr, lineno, held_locks)], [(callee, held_locks)])``.
+    Held state is the SET of locks (a wrong-lock access — guarded by
+    ``_lock`` but touched under ``_other`` — is exactly the race class
+    the rule exists for, so "some lock held" must not pass), tracked
+    through ``with self._lock:`` / lock-backed-Condition blocks;
+    nested function bodies reset it (a closure runs later, on whatever
+    thread calls it, not under the creating block's lock)."""
+    out: List[Tuple[str, int, frozenset]] = []
+    calls: List[Tuple[str, frozenset]] = []
+
+    def visit(node: ast.AST, held: frozenset) -> None:
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            new_held = held
+            for item in node.items:
+                name = ClassInfo._self_attr(item.context_expr)
+                lock = info.held_lock(name) if name else None
+                if lock is not None:
+                    new_held = new_held | {lock}
+                visit(item.context_expr, held)
+            for stmt in node.body:
+                visit(stmt, new_held)
+            return
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            for child in ast.iter_child_nodes(node):
+                visit(child, _NO_LOCKS)
+            return
+        if isinstance(node, ast.Call):
+            callee = ClassInfo._self_attr(node.func)
+            if callee is not None and callee in info.methods:
+                calls.append((callee, held))
+        elif isinstance(node, ast.Attribute):
+            name = ClassInfo._self_attr(node)
+            if name is not None and info.held_lock(name) is None:
+                # method CALLS are dispatch, not state access
+                is_method = name in info.methods
+                if not is_method:
+                    out.append((name, node.lineno, held))
+        for child in ast.iter_child_nodes(node):
+            visit(child, held)
+
+    for stmt in getattr(meth, "body", []):
+        visit(stmt, _NO_LOCKS)
+    return out, calls
+
+
+@project_rule(
+    "lock-discipline",
+    "attribute guarded by a class's threading.Lock (predominantly "
+    "accessed under `with self._lock`) read/written lock-free in a "
+    "method reachable from a thread entry point — the static twin of "
+    "the TRAJ-enqueue-vs-_mark_dead races")
+def _check_lock_discipline(project: ProjectContext):
+    for info in project.classes:
+        if not (info.lock_attrs or info.lock_aliases):
+            continue
+        # accesses per attr: per-lock tallies + every site's held SET
+        prethread = ("__init__", "__post_init__", "__del__")
+        inside: Dict[str, Dict[str, int]] = {}
+        sites: Dict[str, List[Tuple[str, int, frozenset]]] = {}
+        # callee -> [(caller, locks held at the call site)] — ONE
+        # traversal feeds both the call graph and the access stats
+        call_sites: Dict[str, List[Tuple[str, frozenset]]] = {}
+        edges: Dict[str, Set[str]] = {}
+        for mname, meth in info.methods.items():
+            accesses, calls = _method_accesses(info, meth)
+            edges[mname] = {callee for callee, _ in calls}
+            for callee, held in calls:
+                call_sites.setdefault(callee, []).append((mname, held))
+            if mname in prethread:
+                continue  # construction/teardown runs pre/post-thread
+            for attr, line, held in accesses:
+                sites.setdefault(attr, []).append((mname, line, held))
+                for lock in held:
+                    inside.setdefault(attr, {})
+                    inside[attr][lock] = inside[attr].get(lock, 0) + 1
+        guarded: Dict[str, Tuple[str, int, int]] = {}
+        for attr, per_lock in inside.items():
+            lock, n_in = max(per_lock.items(), key=lambda kv: kv[1])
+            # "outside" = every access NOT holding the guarding lock —
+            # an access under a DIFFERENT lock is no protection at all
+            n_out = sum(1 for _, _, held in sites[attr]
+                        if lock not in held)
+            if n_in >= 2 and n_in > n_out:
+                guarded[attr] = (lock, n_in, n_out)
+        if not guarded:
+            continue
+        entries = project.thread_entries(info)
+        if not entries:
+            continue
+        # class-local call-graph closure from the entry points (edges
+        # were collected in the single traversal above)
+        reachable: Set[str] = set()
+        stack = [e for e in entries if e in info.methods]
+        while stack:
+            m = stack.pop()
+            if m in reachable:
+                continue
+            reachable.add(m)
+            stack.extend(edges.get(m, ()))
+        # A non-entry helper whose EVERY in-class call site holds a
+        # given lock (transitively — the caller may itself be such a
+        # helper) runs under that lock even though its own body shows
+        # no `with`: the `_mark_dead`-style caller-holds-lock refactor
+        # must not force bogus suppressions.  Per-LOCK fixpoint over
+        # the call graph — holding a different lock is no exemption.
+        def always_locked_under(lock: str) -> Set[str]:
+            locked: Set[str] = set()
+            changed = True
+            while changed:
+                changed = False
+                for mname in info.methods:
+                    if mname in locked or mname in entries:
+                        continue
+                    # pre-thread call sites (__init__ etc.) are
+                    # excluded: an unlocked call before any thread
+                    # exists is safe and must not defeat the exemption
+                    callers = [c for c in call_sites.get(mname, ())
+                               if c[0] not in prethread]
+                    if not callers:
+                        continue
+                    if all(lock in held or caller in locked
+                           for caller, held in callers):
+                        locked.add(mname)
+                        changed = True
+            return locked
+
+        exempt_cache: Dict[str, Set[str]] = {}
+        for attr, (lock, n_in, n_out) in sorted(guarded.items()):
+            if lock not in exempt_cache:
+                exempt_cache[lock] = always_locked_under(lock)
+            exempt = exempt_cache[lock]
+            for mname, line, held in sites.get(attr, ()):
+                if lock in held or mname not in reachable or \
+                        mname in exempt:
+                    continue
+                how = (f"under self.{next(iter(held))} (a DIFFERENT "
+                       "lock — no mutual exclusion)" if held
+                       else "lock-free")
+                yield Finding(
+                    "lock-discipline", info.ctx.path, line,
+                    f"{info.name}.{attr} is guarded by self.{lock} "
+                    f"({n_in} of {n_in + n_out} accesses hold it) but "
+                    f"accessed {how} in {mname}(), which runs on a "
+                    f"thread entry path ({', '.join(sorted(entries))})",
+                    hint=f"take `with self.{lock}:` around the access, "
+                         "or justify the benign race with "
+                         "# orion: ignore[lock-discipline] <why>")
+
+
+# ---------------------------------------------------------------------------
+# project rule: frame-exhaustive
+# ---------------------------------------------------------------------------
+
+def _fmt_str(fmt) -> str:
+    """struct.Struct accepts str AND bytes formats — normalize so
+    ``b">4sH"`` and ``">4sH"`` compare equal."""
+    return fmt.decode("ascii", "replace") if isinstance(fmt, bytes) \
+        else str(fmt)
+
+
+def _frame_name(ctx: ModuleContext, node: ast.AST,
+                universe: Dict[str, int]) -> Optional[str]:
+    d = ctx.dotted(node)
+    if d is None:
+        return None
+    leaf = d.split(".")[-1]
+    return leaf if leaf in universe else None
+
+
+def _elif_child(node: ast.If) -> Optional[ast.If]:
+    """The chained ``elif`` of an If ladder, or None.  A true elif
+    shares the parent's column; an ``else:`` whose body happens to be
+    one nested ``if`` is indented DEEPER and is a catch-all handler,
+    not another branch — flattening it would hide its raise/log from
+    the loud-else credit."""
+    if len(node.orelse) == 1 and isinstance(node.orelse[0], ast.If) \
+            and node.orelse[0].col_offset == node.col_offset:
+        return node.orelse[0]
+    return None
+
+
+def _chain_branches(root: ast.If) -> Tuple[List[ast.If], List[ast.stmt]]:
+    """Flatten an if/elif/.../else ladder: (branch If nodes, final
+    else body — [] when absent)."""
+    branches = [root]
+    node = root
+    while (child := _elif_child(node)) is not None:
+        node = child
+        branches.append(node)
+    return branches, node.orelse
+
+
+def _else_is_loud(stmts: List[ast.stmt]) -> bool:
+    """A catch-all else "handles" unknown frames only if it raises or
+    logs — `pass`/silent fallthrough drops the frame on the floor."""
+    for stmt in stmts:
+        for sub in ast.walk(stmt):
+            if isinstance(sub, ast.Raise):
+                return True
+            if isinstance(sub, ast.Call) and \
+                    isinstance(sub.func, ast.Attribute) and \
+                    sub.func.attr in ("warning", "error", "critical",
+                                      "exception"):
+                return True
+    return False
+
+
+@project_rule(
+    "frame-exhaustive",
+    "ORTP wire discipline: every frame-dispatch if/elif chain must "
+    "handle or loudly reject every FRAME_* kind, and the header pack "
+    "format must be registered under the current PROTOCOL_VERSION in "
+    "a *_HISTORY table (a format change forces a version bump)")
+def _check_frame_exhaustive(project: ProjectContext):
+    universe = project.frame_constants
+    for m in project.modules:
+        # (1) dispatch-chain exhaustiveness — judged against the
+        # frames THIS module knows (defines, imports, or mentions
+        # anywhere), not the whole project: a second frame family
+        # (e.g. a streaming gateway's STREAM_* peers) must not make
+        # every fully-handled foreign chain fail the gate.
+        if universe:
+            local: Set[str] = set()
+            for alias, target in m.aliases.items():
+                if alias in universe:
+                    local.add(alias)
+                # renamed imports count by their TARGET: `from remote
+                # import FRAME_C as GOODBYE` still owes FRAME_C a
+                # branch (dotted() resolves mentions through the
+                # alias, so the handled-set already speaks leaf names)
+                leaf = target.split(".")[-1]
+                if leaf in universe:
+                    local.add(leaf)
+            for node in m.walk():
+                if isinstance(node, ast.Name) and node.id in universe:
+                    local.add(node.id)
+                elif isinstance(node, ast.Attribute) and \
+                        node.attr in universe:
+                    local.add(node.attr)
+            elif_members: Set[int] = set()
+            for node in m.walk():
+                if isinstance(node, ast.If):
+                    child = _elif_child(node)
+                    if child is not None:
+                        elif_members.add(id(child))
+            for node in m.walk():
+                if not isinstance(node, ast.If) or \
+                        id(node) in elif_members:
+                    continue
+                branches, orelse = _chain_branches(node)
+                mentioned: Set[str] = set()
+                subjects: Set[str] = set()
+                frame_branches = 0
+                for br in branches:
+                    test = br.test
+                    if not isinstance(test, ast.Compare) or \
+                            len(test.ops) != 1:
+                        continue
+                    cmp_nodes: List[ast.AST] = []
+                    if isinstance(test.ops[0], ast.Eq):
+                        cmp_nodes = [test.left, test.comparators[0]]
+                    elif isinstance(test.ops[0], ast.In) and isinstance(
+                            test.comparators[0], (ast.Tuple, ast.Set)):
+                        cmp_nodes = [test.left] + \
+                            list(test.comparators[0].elts)
+                    frames_here = {f for n in cmp_nodes
+                                   if (f := _frame_name(m, n, universe))}
+                    if not frames_here:
+                        continue
+                    frame_branches += 1
+                    mentioned |= frames_here
+                    others = [m.dotted(n) or ast.dump(n)
+                              for n in cmp_nodes
+                              if _frame_name(m, n, universe) is None]
+                    subjects.update(others)
+                if frame_branches < 2 or len(subjects) > 1:
+                    continue  # a guard or unrelated ifs, not a dispatch
+                missing = sorted(local - mentioned)
+                if missing and not _else_is_loud(orelse):
+                    yield Finding(
+                        "frame-exhaustive", m.path, node.lineno,
+                        f"frame dispatch handles "
+                        f"{{{', '.join(sorted(mentioned))}}} but "
+                        f"silently drops {{{', '.join(missing)}}} "
+                        "(no raising/logging else)",
+                        hint="add `else: raise ProtocolError(...)` (or "
+                             "an explicit branch per frame) so an "
+                             "unexpected or future frame kind is "
+                             "rejected loudly, never dropped")
+        # (2) header-format <-> PROTOCOL_VERSION coupling.  ALL
+        # headers are collected and each validated against its OWN
+        # *_HISTORY table — a second wire header later in the module
+        # must not mask the first one's unbumped format edit.
+        version: Optional[int] = None
+        version_line = 1
+        headers: List[Tuple[str, str, int]] = []  # (name, fmt, line)
+        histories: Dict[str, Dict] = {}
+        for node in m.walk():
+            targets, value = _assign_targets_value(node)
+            if value is None:
+                continue
+            names = [t.id for t in targets
+                     if isinstance(t, ast.Name)]
+            if "PROTOCOL_VERSION" in names and \
+                    isinstance(value, ast.Constant) and \
+                    isinstance(value.value, int):
+                version, version_line = value.value, node.lineno
+            for name in names:
+                if "HEADER" in name.upper() and \
+                        "HISTORY" not in name.upper() and \
+                        isinstance(value, ast.Call) and \
+                        m.dotted(value.func) == "struct.Struct" and \
+                        value.args and \
+                        isinstance(value.args[0], ast.Constant):
+                    headers.append((name, _fmt_str(value.args[0].value),
+                                    node.lineno))
+                if name.upper().endswith("HISTORY") and \
+                        isinstance(value, ast.Dict):
+                    hist: Dict = {}
+                    for k, v in zip(value.keys, value.values):
+                        # int version -> str/bytes format ONLY: a
+                        # malformed key (e.g. a quoted "3") must not
+                        # reach the max() comparison and crash the
+                        # whole run; bytes formats are normalized so
+                        # b">4sH" and ">4sH" compare equal
+                        if isinstance(k, ast.Constant) and \
+                                isinstance(k.value, int) and \
+                                isinstance(v, ast.Constant) and \
+                                isinstance(v.value, (str, bytes)):
+                            hist[k.value] = _fmt_str(v.value)
+                    histories[name] = hist
+        if version is None:
+            continue
+        for header_name, header_fmt, header_line in headers:
+            # tied to the header's NAME: an unrelated *_HISTORY dict
+            # in the same module must not clobber the header's table
+            history = histories.get(f"{header_name}_HISTORY")
+            if history is None:
+                yield Finding(
+                    "frame-exhaustive", m.path, header_line,
+                    f"wire header {header_name} has no version-history "
+                    f"table ({header_name}_HISTORY) tying its pack "
+                    "format to PROTOCOL_VERSION",
+                    hint=f"add `{header_name}_HISTORY = {{"
+                         + str(version) +
+                         f": {header_fmt!r}}}` next to the header; a "
+                         "format change then forces a version bump")
+                continue
+            if history.get(version) != header_fmt:
+                yield Finding(
+                    "frame-exhaustive", m.path, header_line,
+                    f"{header_name} pack format {header_fmt!r} is not "
+                    f"the registered format for PROTOCOL_VERSION "
+                    f"{version} (history has "
+                    f"{history.get(version)!r})",
+                    hint="a pack-format change is a wire-format "
+                         "change: bump PROTOCOL_VERSION and append "
+                         "the new format to the history table (the "
+                         "PR 9 v3-to-v4 rule)")
+            elif max(history) != version:
+                yield Finding(
+                    "frame-exhaustive", m.path, version_line,
+                    f"PROTOCOL_VERSION {version} is older than the "
+                    f"newest {header_name}_HISTORY entry "
+                    f"{max(history)}",
+                    hint="the current version must be the newest "
+                         "history entry — remove future entries or "
+                         "bump PROTOCOL_VERSION")
+
+
+# ---------------------------------------------------------------------------
+# project rule: config-drift
+# ---------------------------------------------------------------------------
+
+def _cfg_hint(name: Optional[str],
+              known_classes: Set[str] = frozenset()) -> bool:
+    """Does a dotted base look like an ORION config object (``cfg``,
+    ``self.config``, ``rcfg``, ``train_cfg``, a ``*Config`` class we
+    defined)?  Foreign configs are excluded — ``jax.config`` is a flag
+    registry, ``hf_cfg``/``AutoConfig`` are HuggingFace objects whose
+    fields this project does not declare."""
+    if not name:
+        return False
+    leaf = name.split(".")[-1]
+    if leaf in known_classes:
+        return True
+    low = leaf.lower()
+    if "cfg" not in low and "config" not in low:
+        return False
+    if name.startswith("jax.") or low.startswith("hf"):
+        return False
+    if leaf[0].isupper():
+        return False  # a foreign class object (transformers.AutoConfig)
+    return True
+
+
+@project_rule(
+    "config-drift",
+    "config dataclass fields vs reality: a knob no module outside the "
+    "config module / tests ever reads (unwired), or a cfg.*/getattr "
+    "read naming a field no config class defines (typo/drift)")
+def _check_config_drift(project: ProjectContext):
+    configs = project.config_classes()
+    if not configs:
+        return
+    by_name = {c.name: c for c in configs}
+
+    def all_fields(info: ClassInfo,
+                   _seen: Optional[Set[str]] = None) -> Set[str]:
+        # _seen guards statically-cyclic inheritance (a typo'd base on
+        # WIP code parses fine) — a linter degrades on broken input,
+        # it never dies with RecursionError
+        seen = _seen if _seen is not None else set()
+        if info.name in seen:
+            return set()
+        seen.add(info.name)
+        out = set(info.fields)
+        for b in info.bases:
+            base = by_name.get((b or "").split(".")[-1])
+            if base is not None:
+                out |= all_fields(base, seen)
+        return out
+
+    # field name -> sub-config class, for nested reads (cfg.rollout.X)
+    sub_map: Dict[str, ClassInfo] = {}
+    member_union: Set[str] = set()
+    for c in configs:
+        member_union |= set(c.fields) | set(c.methods)
+        for fname, ann in c.fields.items():
+            ann_name = None
+            if isinstance(ann.annotation, ast.Name):
+                ann_name = ann.annotation.id
+            elif isinstance(ann.annotation, ast.Constant):
+                ann_name = str(ann.annotation.value)
+            if ann_name in by_name:
+                sub_map[fname] = by_name[ann_name]
+    # names defined at top level of the config modules (load_config,
+    # the classes themselves) are legal through a module-alias base
+    defining_paths = {c.ctx.path for c in configs}
+    module_names: Set[str] = set()
+    for path in defining_paths:
+        mod = project.by_path[path]
+        for stmt in mod.tree.body:
+            targets, _ = _assign_targets_value(stmt)
+            for t in targets:
+                if isinstance(t, ast.Name):
+                    module_names.add(t.id)
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                module_names.add(stmt.name)
+
+    # (a) unwired knobs.  A field read by a NON-dunder config method
+    # that outside code calls (MeshConfig.resolved_shape,
+    # ResilienceConfig.retry_policy) is wired THROUGH that method —
+    # but __post_init__ reads alone are validation, not wiring: a knob
+    # that is only ever validated still does nothing.  Iterated to a
+    # FIXPOINT: an externally-called method may delegate to a helper
+    # defined before it in the class body, and the helper's reads must
+    # count regardless of definition order.
+    used = set(project.usage_names())
+    changed = True
+    while changed:
+        changed = False
+        for c in configs:
+            for mname, meth in c.methods.items():
+                if mname.startswith("__") or mname not in used:
+                    continue
+                for sub in ast.walk(meth):
+                    if isinstance(sub, ast.Attribute) and \
+                            isinstance(sub.value, ast.Name) and \
+                            sub.value.id == "self" and \
+                            sub.attr not in used:
+                        used.add(sub.attr)
+                        changed = True
+    for c in configs:
+        for fname, ann in sorted(c.fields.items()):
+            if fname not in used:
+                yield Finding(
+                    "config-drift", c.ctx.path, ann.lineno,
+                    f"config knob {c.name}.{fname} is never read "
+                    "outside the config module / tests — an unwired "
+                    "setting silently does nothing",
+                    hint="wire it into the subsystem it configures, "
+                         "delete it, or justify with "
+                         "# orion: ignore[config-drift] <why>")
+
+    # (b) phantom reads
+    legal_direct = member_union | set(sub_map) | module_names
+    known_classes = set(by_name)
+    for m in project.modules:
+        if m.path in defining_paths or is_test_path(m.path):
+            continue
+        for node in m.walk():
+            if isinstance(node, ast.Attribute) and \
+                    isinstance(getattr(node, "ctx", None), ast.Load):
+                leaf = node.attr
+                base = node.value
+                if isinstance(base, ast.Attribute) and \
+                        base.attr in sub_map and \
+                        _cfg_hint(m.dotted(base.value), known_classes):
+                    sub = sub_map[base.attr]
+                    members = all_fields(sub) | set(sub.methods)
+                    if leaf not in members and not leaf.startswith("__"):
+                        yield Finding(
+                            "config-drift", m.path, node.lineno,
+                            f"read of .{base.attr}.{leaf}: "
+                            f"{sub.name} defines no field or method "
+                            f"{leaf!r}",
+                            hint=f"{sub.name} fields are declared in "
+                                 "the config module — fix the name or "
+                                 "add the field (with validation)")
+                elif _cfg_hint(m.dotted(base), known_classes) and \
+                        not isinstance(base, ast.Call):
+                    if leaf not in legal_direct and \
+                            not leaf.startswith("__"):
+                        yield Finding(
+                            "config-drift", m.path, node.lineno,
+                            f"read of .{leaf} on a config object: no "
+                            "config class defines it",
+                            hint="fix the field name, or add the field "
+                                 "to the right config dataclass")
+            elif isinstance(node, ast.Call):
+                d = m.dotted(node.func)
+                if d == "getattr" and len(node.args) == 2 and \
+                        isinstance(node.args[1], ast.Constant) and \
+                        isinstance(node.args[1].value, str) and \
+                        _cfg_hint(m.dotted(node.args[0]),
+                                  known_classes):
+                    leaf = node.args[1].value
+                    if leaf not in legal_direct and \
+                            not leaf.startswith("__"):
+                        yield Finding(
+                            "config-drift", m.path, node.lineno,
+                            f"getattr(cfg, {leaf!r}): no config class "
+                            "defines that field",
+                            hint="fix the field name (a 2-arg getattr "
+                                 "raises at runtime on drift; 3-arg "
+                                 "defaults are exempt)")

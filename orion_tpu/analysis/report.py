@@ -1,14 +1,25 @@
-"""Findings report: one line per finding, file:line first so terminals
-and editors can jump to it, plus a one-line fix hint."""
+"""Findings reports: the human terminal format (file:line first so
+editors can jump), machine formats for CI (``--format json|sarif``),
+and the baseline file that lets a new project rule land warn-first and
+tighten to the self-gate later.
+
+SARIF output follows the 2.1.0 log-file shape (``version``/``runs``/
+``tool.driver.rules``/``results`` with ``physicalLocation`` regions) so
+GitHub code scanning and any SARIF viewer ingest the gate directly;
+``tests/test_analysis.py`` asserts the shape.
+"""
 
 from __future__ import annotations
 
-from typing import List, Sequence
+import json
+import os
+from typing import Dict, List, Optional, Sequence, Tuple
 
 from orion_tpu.analysis.engine import Finding
 
 
-def format_findings(findings: Sequence[Finding]) -> str:
+def format_findings(findings: Sequence[Finding],
+                    baselined: int = 0) -> str:
     lines: List[str] = []
     for f in findings:
         lines.append(f"{f.path}:{f.line}: [{f.rule_id}] {f.message}")
@@ -19,11 +30,174 @@ def format_findings(findings: Sequence[Finding]) -> str:
         lines.append(f"{n} finding{'s' if n != 1 else ''} "
                      "(suppress a justified one with "
                      "'# orion: ignore[rule-id] <why>')")
+    if baselined:
+        lines.append(f"{baselined} baselined finding"
+                     f"{'s' if baselined != 1 else ''} hidden "
+                     "(tighten by pruning the baseline file)")
     return "\n".join(lines)
+
+
+def format_json(findings: Sequence[Finding],
+                baselined: int = 0) -> str:
+    return json.dumps({
+        "findings": [
+            {"rule": f.rule_id, "path": f.path, "line": f.line,
+             "message": f.message, "hint": f.hint}
+            for f in findings],
+        "count": len(findings),
+        "baselined": baselined,
+    }, indent=2, sort_keys=True)
+
+
+def format_sarif(findings: Sequence[Finding],
+                 rules: Optional[Sequence] = None) -> str:
+    if rules is None:
+        from orion_tpu.analysis.rules import RULES as rules
+    doc = {
+        "$schema": "https://json.schemastore.org/sarif-2.1.0.json",
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "orion-tpu-analysis",
+                "informationUri":
+                    "https://github.com/mnoukhov/orion#static-analysis",
+                "rules": [
+                    {"id": r.id,
+                     "shortDescription": {"text": r.description}}
+                    for r in rules
+                ] + [
+                    # synthetic: emitted by the engine for unparsable
+                    # files and never filterable away, so every result
+                    # ruleId resolves against the driver
+                    {"id": "syntax-error",
+                     "shortDescription": {
+                         "text": "file does not parse — fix the "
+                                 "syntax error first"}},
+                ],
+            }},
+            "results": [
+                {"ruleId": f.rule_id,
+                 "level": "error",
+                 "message": {"text": (f"{f.message} (hint: {f.hint})"
+                                      if f.hint else f.message)},
+                 "locations": [{
+                     "physicalLocation": {
+                         "artifactLocation": {
+                             "uri": f.path.replace(os.sep, "/")},
+                         "region": {"startLine": f.line},
+                     }}]}
+                for f in findings],
+        }],
+    }
+    return json.dumps(doc, indent=2)
 
 
 def format_rule_table() -> str:
     from orion_tpu.analysis.rules import RULES
 
     width = max(len(r.id) for r in RULES)
-    return "\n".join(f"{r.id:<{width}}  {r.description}" for r in RULES)
+    return "\n".join(
+        f"{r.id:<{width}}  "
+        f"[{'project' if getattr(r, 'kind', 'file') == 'project' else 'file':<7}]"
+        f"  {r.description}" for r in RULES)
+
+
+# ---------------------------------------------------------------------------
+# baseline: land a new project rule warn-first, tighten later
+# ---------------------------------------------------------------------------
+
+#: A baseline entry matches on (rule, path, message) WITH a count —
+#: line numbers drift with every edit above a finding (pinning them
+#: would rot the baseline instantly), but an uncounted key-set would
+#: let ONE baselined entry silently absorb every future identical
+#: violation (ruff-style counted matching instead: the (N+1)th
+#: occurrence gates).  Paths are normalized relative to the BASELINE
+#: FILE's directory (``_norm_path``), so relative and absolute
+#: invocations — from any cwd — share keys.
+BaselineKey = Tuple[str, str, str]
+
+
+def _norm_path(p: str, anchor: str) -> str:
+    """Paths are keyed relative to the BASELINE FILE's directory, not
+    the invoking cwd — a baseline written from the repo root must keep
+    matching when the tool later runs from a subdirectory."""
+    return os.path.relpath(os.path.abspath(p),
+                           anchor).replace(os.sep, "/")
+
+
+def _anchor(baseline_path: str) -> str:
+    return os.path.dirname(os.path.abspath(baseline_path)) or "."
+
+
+def _key(f: Finding, anchor: str) -> BaselineKey:
+    return (f.rule_id, _norm_path(f.path, anchor), f.message)
+
+
+def load_baseline(path: str) -> Dict[BaselineKey, int]:
+    with open(path, "r", encoding="utf-8") as fh:
+        data = json.load(fh)
+    if not isinstance(data, dict) or \
+            not isinstance(data.get("findings", []), list):
+        raise ValueError(
+            "baseline must be a JSON object with a 'findings' list "
+            "(regenerate with --update-baseline)")
+    anchor = _anchor(path)
+    out: Dict[BaselineKey, int] = {}
+    for e in data.get("findings", []):
+        # stored paths are anchor-relative already; joining keeps a
+        # hand-written absolute entry working too
+        p = _norm_path(os.path.join(anchor, e["path"]), anchor)
+        key = (e["rule"], p, e["message"])
+        out[key] = out.get(key, 0) + int(e.get("count", 1))
+    return out
+
+
+def write_baseline(path: str, findings: Sequence[Finding]) -> None:
+    anchor = _anchor(path)
+    counts: Dict[BaselineKey, int] = {}
+    for f in findings:
+        if f.rule_id == "syntax-error":
+            continue  # unparsable files always gate — never recorded
+        k = _key(f, anchor)
+        counts[k] = counts.get(k, 0) + 1
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump({
+            "comment": "orion_tpu.analysis baseline: known findings "
+                       "tolerated while a rule lands warn-first; "
+                       "regenerate with --update-baseline, tighten by "
+                       "deleting entries (count-matched: occurrences "
+                       "beyond an entry's count still gate)",
+            "findings": [{"rule": r, "path": p, "message": m,
+                          "count": n}
+                         for (r, p, m), n in sorted(counts.items())],
+        }, fh, indent=2, sort_keys=True)
+        fh.write("\n")
+
+
+def apply_baseline(findings: Sequence[Finding],
+                   baseline: Dict[BaselineKey, int],
+                   baseline_path: str
+                   ) -> Tuple[List[Finding], List[Finding]]:
+    """(new findings, baselined findings) — only NEW findings gate.
+    Each baseline entry absorbs at most its recorded COUNT of matching
+    findings; any excess occurrence is new and gates.
+    ``baseline_path`` anchors path matching to the baseline file's
+    directory (cwd-independent)."""
+    anchor = _anchor(baseline_path)
+    remaining = dict(baseline)
+    fresh: List[Finding] = []
+    known: List[Finding] = []
+    for f in findings:
+        if f.rule_id == "syntax-error":
+            # never absorbable: a baselined gate must not stay green
+            # on a file that does not parse (same invariant the
+            # engine enforces for --rule filters)
+            fresh.append(f)
+            continue
+        k = _key(f, anchor)
+        if remaining.get(k, 0) > 0:
+            remaining[k] -= 1
+            known.append(f)
+        else:
+            fresh.append(f)
+    return fresh, known

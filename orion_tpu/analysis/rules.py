@@ -24,12 +24,16 @@ import ast
 import os
 from typing import Callable, Dict, Iterable, List, Optional, Set, Tuple
 
-from orion_tpu.analysis.engine import Finding, ModuleContext
+from orion_tpu.analysis.engine import Finding, ModuleContext, is_test_path
 
 RULES: List["Rule"] = []
 
 
 class Rule:
+    #: "file" rules see one ModuleContext; "project" rules (defined in
+    #: analysis/project.py) see the whole parsed tree at once.
+    kind = "file"
+
     def __init__(self, rule_id: str, description: str,
                  checker: Callable[[ModuleContext], Iterable[Finding]]):
         self.id = rule_id
@@ -866,9 +870,7 @@ def _check_naked_timer(ctx: ModuleContext):
     # obs IS the timing layer; tests time freely (their scaffolding is
     # not the product's observability surface).
     p = ctx.path.replace(os.sep, "/")
-    base = os.path.basename(p)
-    if "orion_tpu/obs/" in p or "tests/" in p or \
-            base.startswith("test_") or base == "conftest.py":
+    if "orion_tpu/obs/" in p or is_test_path(ctx.path):
         return
 
     def is_timer_call(node: ast.AST) -> bool:
@@ -956,3 +958,32 @@ def _check_raw_socket(ctx: ModuleContext):
                 hint="use orion_tpu.orchestration.remote.PyTreeChannel"
                      " / WorkerPool; a non-IO use (free-port probe) "
                      "can justify # orion: ignore[raw-socket]")
+
+
+# ---------------------------------------------------------------------------
+# rule: unused-suppression (engine-evaluated)
+# ---------------------------------------------------------------------------
+
+
+def _unused_suppression_stub(ctx: ModuleContext):
+    """The real check lives in the engine: a suppression can only be
+    judged against the rules that actually RAN on its line, across
+    BOTH phases (a stale ``# orion: ignore[lock-discipline]`` needs the
+    project phase's verdict).  This stub registers the id so
+    ``--rule`` / ``--list-rules`` / the fixture-coverage test see it."""
+    return ()
+
+
+RULES.append(Rule(
+    "unused-suppression",
+    "an '# orion: ignore[rule-id]' comment whose rule no longer fires "
+    "on that line (ruff unused-noqa semantics) — a dead ignore hides "
+    "the next real finding there",
+    _unused_suppression_stub))
+
+
+# Project rules (analysis/project.py) share this registry so the CLI
+# lists one table; the engine dispatches them by Rule.kind.
+from orion_tpu.analysis.project import PROJECT_RULES  # noqa: E402
+
+RULES.extend(PROJECT_RULES)

@@ -240,7 +240,10 @@ class RolloutConfig:
     # decode tokens per jitted segment.
     max_batch_size: int = 32
     segment_len: int = 16
-    logprobs_dtype: str = "float32"  # f32 softmax to avoid bf16 drift
+    # (logprobs are always computed in f32 — both engines cast logits
+    # to float32 before the softmax to avoid bf16 drift; the old
+    # ``logprobs_dtype`` knob was never wired and was deleted by the
+    # config-drift sweep rather than threaded through the engines.)
     # int8 decode (ops/quant.py): decode is HBM-bound, so storing the
     # decode twin's Dense kernels int8 (weight-only, per-out-channel
     # scales, convert fused into the dot — measured 1.76x on the matmul

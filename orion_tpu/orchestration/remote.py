@@ -99,6 +99,18 @@ PROTOCOL_VERSION = 4
 #: only two header fields.
 _HEADER = struct.Struct(">4sHBQQQ")
 
+#: Wire-format history: PROTOCOL_VERSION -> the header pack format it
+#: shipped with.  The ``frame-exhaustive`` analysis rule enforces that
+#: the CURRENT format is registered under the CURRENT version — so any
+#: edit to ``_HEADER`` fails the gate until PROTOCOL_VERSION is bumped
+#: and a new entry appended (the machine-checked form of the PR 9
+#: v3→v4 rule: a pack-format change IS a wire-format change, and a
+#: skewed peer must fail the version check, not the pickle loader).
+_HEADER_HISTORY = {
+    3: ">4sHBQ",     # PR 6: magic + version + kind + length
+    4: ">4sHBQQQ",   # PR 9: + trace id + span id (distributed tracing)
+}
+
 # Frame kinds multiplexed on one channel.
 FRAME_DATA = 0       # legacy send()/recv() payload
 FRAME_HELLO = 1      # worker → learner admission; learner → worker ack
@@ -551,7 +563,12 @@ class WorkerPool:
                 pickle.UnpicklingError) as e:
             # A stray/mismatched peer fails ITS admission with a
             # clear error; the pool (and its live workers) sail on.
-            self.recovery["worker_refused"] += 1
+            # Counter increments take the pool lock: admission threads,
+            # recv threads and the learner all bump ``recovery``, and a
+            # dict-entry += is a read-modify-write that drops updates
+            # under contention (lock-discipline rule).
+            with self._lock:
+                self.recovery["worker_refused"] += 1
             self._event("worker-refused", repr(e))
             _LOG.warning("worker pool refused a peer at %s: %s",
                          addr, e)
@@ -589,7 +606,8 @@ class WorkerPool:
         if exhausted:
             # Counters first: the GOODBYE frame races the caller's
             # "was it refused?" check the moment it hits the wire.
-            self.recovery["worker_refused"] += 1
+            with self._lock:
+                self.recovery["worker_refused"] += 1
             self._event("worker-refused",
                         f"rejoin budget ({self.rejoin_budget})")
             chan.send_frame(FRAME_GOODBYE,
@@ -656,7 +674,8 @@ class WorkerPool:
                 pass
             raise ConnectionError("pool shut down during admission")
         member.thread.start()
-        self.recovery["worker_joins"] += 1
+        with self._lock:
+            self.recovery["worker_joins"] += 1
         self._event("worker-join", (wid, name))
         _LOG.info("worker pool admitted %s as wid=%d (%d live)",
                   name, wid, len(self.live_members()))
@@ -706,8 +725,8 @@ class WorkerPool:
                 return
             member.left = True
             member.alive = False
+            self.recovery["worker_leaves"] += 1
         self.watchdog.unregister(member.hb.name)
-        self.recovery["worker_leaves"] += 1
         self._event("worker-leave", member.wid)
         _LOG.info("worker wid=%d said GOODBYE (graceful; %d queued "
                   "batches stay consumable)", member.wid,
@@ -737,8 +756,12 @@ class WorkerPool:
                 discarded += 1
             except queue.Empty:
                 break
-        self.recovery["worker_deaths"] += 1
-        self.recovery["discarded_batches"] += discarded
+        with self._lock:
+            self.recovery["worker_deaths"] += 1
+            self.recovery["discarded_batches"] += discarded
+            # Snapshot for the flight dump below while we hold the
+            # lock — another recv/admission thread may be mid-update.
+            recovery_snap = dict(self.recovery)
         self._event("worker-death", (member.wid, discarded))
         _LOG.error("worker wid=%d dead (%s); %d in-flight batches "
                    "discarded; %d workers remain", member.wid, reason,
@@ -750,7 +773,7 @@ class WorkerPool:
             "transition": "degradation-ladder: worker marked dead, "
                           "survivors absorb the load",
             "wid": member.wid, "name": member.name, "reason": reason,
-            "discarded": discarded, "recovery": dict(self.recovery)})
+            "discarded": discarded, "recovery": recovery_snap})
         try:
             member.chan.close()
         except OSError:
@@ -867,13 +890,15 @@ class WorkerPool:
                 continue
             with self._lock:
                 suspect = not chosen.alive and not chosen.left
+                if suspect:
+                    # get() raced _mark_dead's queue drain and stole
+                    # an item the drain was about to throw away.  A
+                    # crashed worker's batch is suspect no matter
+                    # which thread pulled it off the queue — discard
+                    # it here (the drain can no longer see it, so it
+                    # counts it nowhere).
+                    self.recovery["discarded_batches"] += 1
             if suspect:
-                # get() raced _mark_dead's queue drain and stole an
-                # item the drain was about to throw away.  A crashed
-                # worker's batch is suspect no matter which thread
-                # pulled it off the queue — discard it here (the drain
-                # can no longer see it, so it counts it nowhere).
-                self.recovery["discarded_batches"] += 1
                 self._event("discard-raced", chosen.wid)
                 continue
             chosen.consumed += 1
@@ -895,7 +920,8 @@ class WorkerPool:
                     # item — same invariant as the suspect re-check
                     # above: a crashed worker's batch is discarded,
                     # never donated.
-                    self.recovery["discarded_batches"] += 1
+                    with self._lock:
+                        self.recovery["discarded_batches"] += 1
                     self._event("discard-raced", chosen.wid)
                     continue
             return chosen, item
@@ -1082,6 +1108,18 @@ class PoolWorkerClient:
                     with self._weights_cv:
                         self._weights_cv.notify_all()
                     return
+                else:
+                    # The learner only ever sends WEIGHTS/ACK/GOODBYE
+                    # after the handshake: anything else is protocol
+                    # confusion, and silently dropping it would leave
+                    # a skewed peer undetected until it wedged the
+                    # staleness gate.  ProtocolError is a
+                    # ConnectionError — the except below sets
+                    # ``closed`` and wakes every waiter, same as any
+                    # other broken channel (frame-exhaustive rule).
+                    raise ProtocolError(
+                        f"unexpected {_FRAME_NAMES.get(kind, kind)} "
+                        "frame from learner")
         except (ConnectionError, TimeoutError, OSError, EOFError,
                 pickle.UnpicklingError):
             self.closed.set()

@@ -300,7 +300,7 @@ def serve_dense(dense, sh, prompts, budgets, arrivals):
         r = dense.generate(jnp.asarray(ids), jnp.asarray(lens),
                            jax.random.key(batch[0]), max_new_tokens=t)
         np.asarray(r.completion_lens)  # real fetch
-        tdone = time.perf_counter() - t0  # orion: ignore[bench-no-block, naked-timer] completion_lens fetch above drained the batch
+        tdone = time.perf_counter() - t0  # orion: ignore[naked-timer] completion_lens fetch above drained the batch
         for gi in batch:
             done_t[gi] = tdone
     return time.perf_counter() - t0, done_t  # orion: ignore[naked-timer] the bench's wall window IS the metric
@@ -330,7 +330,7 @@ def serve_continuous(cont, sh, prompts, budgets, arrivals, deadlines):
         for r in cont.step():  # step drains completions to host
             done_t[r.req_id] = time.perf_counter() - t0  # orion: ignore[bench-no-block, naked-timer] step() fetched this completion
             n_done += 1
-    return time.perf_counter() - t0, done_t  # orion: ignore[bench-no-block, naked-timer] step() fetched every completion
+    return time.perf_counter() - t0, done_t  # orion: ignore[naked-timer] step() fetched every completion
 
 
 def warm_buckets(dense, cont, sh):

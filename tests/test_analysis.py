@@ -1,13 +1,16 @@
 """orion_tpu.analysis: rule fixtures (one positive + one negative per
-rule), suppression, the CLI exit code, the runtime guards — and the
-self-gate: the engine over the shipped tree must report ZERO
-unsuppressed findings, so every future PR keeps the repo lint-clean.
+rule — multi-file dict fixtures exercise the PROJECT phase),
+suppression, the CLI exit codes + CI formats (json/sarif/baseline),
+the result cache, the runtime guards — and the self-gate: both phases
+over the shipped tree must report ZERO unsuppressed findings, so every
+future PR keeps the repo lint-clean.
 
 Named test_analysis.py deliberately: it sorts early in tier-1 and the
 whole file is AST-only except the two runtime-guard tests, so the gate
 costs seconds.
 """
 
+import json
 import os
 import logging
 import subprocess
@@ -18,9 +21,12 @@ import warnings
 import pytest
 
 from orion_tpu.analysis import (RULES, analyze_paths, analyze_source,
-                                format_findings)
+                                analyze_sources, format_findings)
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+LINT_PATHS = ("orion_tpu", "tests", "scripts", "bench.py",
+              "__graft_entry__.py")
 
 
 def ids_of(findings):
@@ -29,6 +35,13 @@ def ids_of(findings):
 
 def run_on(snippet: str, path: str = "x.py"):
     return analyze_source(textwrap.dedent(snippet), path)
+
+
+def run_on_files(files: dict):
+    """Run both phases over an in-memory multi-module project — the
+    cross-file (project-rule) analogue of :func:`run_on`."""
+    return analyze_sources([(p, textwrap.dedent(s))
+                            for p, s in files.items()])
 
 
 # ---------------------------------------------------------------------------
@@ -506,6 +519,198 @@ FIXTURES = [
         """,
         "orion_tpu/fake_io.py",
     ),
+    (
+        # the seeded race: the PR 6 TRAJ-discard shape — a recv thread
+        # reads `alive` bare while consume/shutdown guard it
+        "lock-discipline",
+        """
+        import queue
+        import threading
+
+        class Pool:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.alive = True
+                self.inbox = queue.Queue()
+                self.discarded = 0
+                self._t = threading.Thread(target=self._recv_loop)
+                self._t.start()
+
+            def consume(self):
+                with self._lock:
+                    if self.alive:
+                        return self.inbox.get_nowait()
+                    return None
+
+            def shutdown(self):
+                with self._lock:
+                    self.alive = False
+                    self.discarded += 1
+
+            def _recv_loop(self):
+                while self.alive:
+                    self.inbox.put(1)
+        """,
+        """
+        import queue
+        import threading
+
+        class Pool:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.alive = True
+                self.inbox = queue.Queue()
+                self.discarded = 0
+                self._t = threading.Thread(target=self._recv_loop)
+                self._t.start()
+
+            def consume(self):
+                with self._lock:
+                    if self.alive:
+                        return self.inbox.get_nowait()
+                    return None
+
+            def shutdown(self):
+                with self._lock:
+                    self.alive = False
+                    self.discarded += 1
+
+            def _recv_loop(self):
+                while True:
+                    with self._lock:
+                        if not self.alive:
+                            return
+                        self.inbox.put(1)
+        """,
+        "pool.py",
+    ),
+    (
+        # dispatch gap: FRAME_C silently dropped, no raising else
+        "frame-exhaustive",
+        """
+        FRAME_A = 0
+        FRAME_B = 1
+        FRAME_C = 2
+
+        def dispatch(kind, payload):
+            if kind == FRAME_A:
+                return payload
+            elif kind == FRAME_B:
+                return None
+        """,
+        """
+        FRAME_A = 0
+        FRAME_B = 1
+        FRAME_C = 2
+
+        def dispatch(kind, payload):
+            if kind == FRAME_A:
+                return payload
+            elif kind == FRAME_B:
+                return None
+            else:
+                raise ValueError(f"unexpected frame {kind}")
+        """,
+        "wire.py",
+    ),
+    (
+        # header format drifted from the registered PROTOCOL_VERSION
+        # entry (the PR 9 v3-to-v4 rule, structurally checked)
+        "frame-exhaustive",
+        """
+        import struct
+
+        PROTOCOL_VERSION = 2
+        _HEADER = struct.Struct(">4sHB")
+        _HEADER_HISTORY = {1: ">4sH", 2: ">4sHQ"}
+        """,
+        """
+        import struct
+
+        PROTOCOL_VERSION = 2
+        _HEADER = struct.Struct(">4sHB")
+        _HEADER_HISTORY = {1: ">4sH", 2: ">4sHB"}
+        """,
+        "wire2.py",
+    ),
+    (
+        # orphaned knob: a field nothing outside the config module reads
+        "config-drift",
+        {
+            "myconfig.py": """
+            import dataclasses
+
+            @dataclasses.dataclass
+            class ServeConfig:
+                port: int = 0
+                orphan_knob: int = 2
+            """,
+            "server.py": """
+            def serve(cfg):
+                return cfg.port
+            """,
+        },
+        {
+            "myconfig.py": """
+            import dataclasses
+
+            @dataclasses.dataclass
+            class ServeConfig:
+                port: int = 0
+                orphan_knob: int = 2
+            """,
+            "server.py": """
+            def serve(cfg):
+                return cfg.port + cfg.orphan_knob
+            """,
+        },
+        None,
+    ),
+    (
+        # phantom read: a cfg.* access naming a field no config defines
+        "config-drift",
+        {
+            "myconfig.py": """
+            import dataclasses
+
+            @dataclasses.dataclass
+            class ServeConfig:
+                port: int = 0
+            """,
+            "server.py": """
+            def serve(cfg):
+                return cfg.prot
+            """,
+        },
+        {
+            "myconfig.py": """
+            import dataclasses
+
+            @dataclasses.dataclass
+            class ServeConfig:
+                port: int = 0
+            """,
+            "server.py": """
+            def serve(cfg):
+                return cfg.port
+            """,
+        },
+        None,
+    ),
+    (
+        "unused-suppression",
+        """
+        X = 1  # orion: ignore[prng-reuse] stale justification
+        """,
+        """
+        import jax
+
+        @jax.jit
+        def f(x):
+            return x.sum().item()  # orion: ignore[host-sync-in-jit] dbg
+        """,
+        "x.py",
+    ),
 ]
 
 
@@ -514,12 +719,14 @@ FIXTURES = [
     FIXTURES,
     ids=[f"{r}-{i}" for i, (r, *_rest) in enumerate(FIXTURES)])
 def test_rule_fixtures(rule_id, pos, neg, path):
-    hits = run_on(pos, path)
+    run = run_on_files if isinstance(pos, dict) else \
+        (lambda s: run_on(s, path))
+    hits = run(pos)
     assert rule_id in ids_of(hits), \
         f"positive fixture did not fire {rule_id}"
     assert all(f.hint for f in hits if f.rule_id == rule_id), \
         "every finding carries a fix hint"
-    assert rule_id not in ids_of(run_on(neg, path)), \
+    assert rule_id not in ids_of(run(neg)), \
         f"negative fixture wrongly fired {rule_id}"
 
 
@@ -527,7 +734,10 @@ def test_every_rule_has_fixture_coverage():
     covered = {r for r, *_ in FIXTURES}
     assert covered == {r.id for r in RULES}, \
         "each registered rule needs a positive+negative fixture here"
-    assert len(RULES) >= 10
+    assert len(RULES) >= 15
+    kinds = {r.id: getattr(r, "kind", "file") for r in RULES}
+    assert {k for k, v in kinds.items() if v == "project"} == \
+        {"lock-discipline", "frame-exhaustive", "config-drift"}
 
 
 def test_naked_timer_exempts_obs_and_tests():
@@ -629,8 +839,11 @@ def test_syntax_error_reports_instead_of_crashing():
 
 def _run_cli(*args):
     env = dict(os.environ, JAX_PLATFORMS="cpu")
+    # --no-cache: tests must never write tmp-path entries into the
+    # developer's live lint cache under ~/.cache
     return subprocess.run(
-        [sys.executable, "-m", "orion_tpu.analysis", *args],
+        [sys.executable, "-m", "orion_tpu.analysis", "--no-cache",
+         *args],
         cwd=REPO, env=env, capture_output=True, text=True, timeout=120)
 
 
@@ -661,8 +874,9 @@ def test_cli_rule_filter_and_listing(tmp_path, capsys):
 
     dirty = tmp_path / "dirty.py"
     dirty.write_text("from jax import shard_map\n")
-    assert main(["--rule", "prng-reuse", str(dirty)]) == 0
-    assert main([str(dirty)]) == 1
+    assert main(["--no-cache", "--rule", "prng-reuse",
+                 str(dirty)]) == 0
+    assert main(["--no-cache", str(dirty)]) == 1
     assert main(["--list-rules"]) == 0
     out = capsys.readouterr().out
     for rl in RULES:
@@ -674,18 +888,13 @@ def test_cli_rule_filter_and_listing(tmp_path, capsys):
 # ---------------------------------------------------------------------------
 
 
-def test_repo_package_is_clean():
-    findings = analyze_paths([os.path.join(REPO, "orion_tpu")])
-    assert findings == [], "\n" + format_findings(findings)
-
-
-def test_repo_scripts_and_tests_are_clean():
-    findings = analyze_paths([
-        os.path.join(REPO, "scripts"),
-        os.path.join(REPO, "tests"),
-        os.path.join(REPO, "bench.py"),
-        os.path.join(REPO, "__graft_entry__.py"),
-    ])
+def test_repo_tree_is_clean_full_gate():
+    """THE self-gate: both phases over the exact scripts/lint.sh path
+    set in ONE invocation (the project rules need every cross-file
+    reader in view) — zero unsuppressed findings, the three project
+    rules ENABLED (full registry, no --rule filter, no baseline)."""
+    findings = analyze_paths([os.path.join(REPO, p)
+                              for p in LINT_PATHS])
     assert findings == [], "\n" + format_findings(findings)
 
 
@@ -795,3 +1004,1023 @@ def test_install_from_config_respects_budget():
         assert sentinel is not None and sentinel.budget == 5
     finally:
         sentinel.uninstall()
+
+
+# ---------------------------------------------------------------------------
+# project phase: cross-file behavior, suppression, wire-history mirror
+# ---------------------------------------------------------------------------
+
+
+def test_project_rule_suppression_and_unused_judgment():
+    """A project-rule finding obeys the same per-line suppression as a
+    per-file finding — and the unused-suppression sweep counts it as
+    USED (a stale-vs-live judgment needs the project phase's verdict,
+    which is why the sweep runs last)."""
+    src = """
+    import queue
+    import threading
+
+    class Pool:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.alive = True
+            self.inbox = queue.Queue()
+            self._t = threading.Thread(target=self._recv_loop)
+
+        def consume(self):
+            with self._lock:
+                if self.alive:
+                    return self.inbox.get_nowait()
+                return None
+
+        def shutdown(self):
+            with self._lock:
+                self.alive = False
+
+        def _recv_loop(self):
+            while self.alive:  # orion: ignore[lock-discipline] bool read is atomic here, latest-wins is fine
+                self.inbox.put(1)
+    """
+    got = ids_of(run_on(src, "pool.py"))
+    assert "lock-discipline" not in got
+    assert "unused-suppression" not in got
+
+
+def test_config_drift_nested_chain_and_getattr():
+    """The TrainConfig shape: `cfg.rollout.<field>` resolves through
+    the sub-config's annotation, and a 2-arg getattr with a string
+    literal is checked too (3-arg defaults are deliberately exempt)."""
+    files = {
+        "myconfig.py": """
+        import dataclasses
+
+        @dataclasses.dataclass
+        class RollConfig:
+            page_watermark: int = -1
+
+        @dataclasses.dataclass
+        class TopConfig:
+            rollout: RollConfig = dataclasses.field(
+                default_factory=RollConfig)
+        """,
+        "engine.py": """
+        def build(cfg):
+            a = cfg.rollout.page_watermark        # ok
+            b = cfg.rollout.page_watermrk         # typo -> finding
+            c = getattr(cfg, "bogus_field")       # finding
+            d = getattr(cfg, "maybe", None)       # 3-arg: exempt
+            return a, b, c, d
+        """,
+    }
+    findings = [f for f in run_on_files(files)
+                if f.rule_id == "config-drift"]
+    msgs = " | ".join(f.message for f in findings)
+    assert "page_watermrk" in msgs
+    assert "bogus_field" in msgs
+    assert "maybe" not in msgs
+    assert "page_watermark is never read" not in msgs
+
+
+def test_frame_exhaustive_accepts_loud_else_subset():
+    """A dispatch chain that handles a direction SUBSET is fine as
+    long as the else rejects loudly — the shipped learner/worker recv
+    loops are exactly this shape."""
+    src = """
+    FRAME_A = 0
+    FRAME_B = 1
+    FRAME_C = 2
+
+    def dispatch(kind):
+        if kind == FRAME_A:
+            return 1
+        elif kind == FRAME_B:
+            return 2
+        else:
+            raise ValueError(f"unexpected frame {kind}")
+    """
+    assert "frame-exhaustive" not in ids_of(run_on(src, "wire.py"))
+
+
+def test_frame_exhaustive_missing_history_table():
+    src = """
+    import struct
+
+    PROTOCOL_VERSION = 1
+    _HEADER = struct.Struct(">4sH")
+    """
+    hits = [f for f in run_on(src, "wire.py")
+            if f.rule_id == "frame-exhaustive"]
+    assert hits and "no version-history table" in hits[0].message
+
+
+def test_wire_history_mirrors_protocol_version():
+    """Runtime twin of the structural check: the shipped remote.py
+    header format IS the registered entry for the shipped version."""
+    from orion_tpu.orchestration.remote import (_HEADER, _HEADER_HISTORY,
+                                                PROTOCOL_VERSION)
+
+    assert _HEADER_HISTORY[PROTOCOL_VERSION] == _HEADER.format
+    assert max(_HEADER_HISTORY) == PROTOCOL_VERSION
+
+
+def test_lock_discipline_ignores_foreign_and_constructor_access():
+    """__init__ runs before any thread exists and jax/HF config
+    objects are not ours — neither may fire."""
+    src = """
+    import threading
+
+    class Box:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.state = 0          # pre-thread: never a finding
+            self.state += 1
+
+        def bump(self):
+            with self._lock:
+                self.state += 1
+
+        def read(self):
+            with self._lock:
+                return self.state
+    """
+    assert "lock-discipline" not in ids_of(run_on(src, "box.py"))
+    jx = """
+    import jax
+
+    def tune(cfg):
+        jax.config.update("jax_default_matmul_precision", "float32")
+        return jax.config.jax_default_matmul_precision
+    """
+    assert "config-drift" not in ids_of(run_on(jx, "tune.py"))
+
+
+def test_unused_suppression_ignores_string_literals():
+    """The marker inside a STRING (a docstring example, a hint
+    template) is prose, not a suppression — tokenize-level comment
+    detection, not a line regex."""
+    src = '''
+    HINT = "justify with # orion: ignore[raw-socket] <why>"
+
+    def doc():
+        """Example: x.item()  # orion: ignore[host-sync-in-jit]"""
+        return HINT
+    '''
+    assert "unused-suppression" not in ids_of(run_on(src, "x.py"))
+
+
+def test_dotted_cache_is_identity_checked_and_keeps_nodes_alive():
+    """Regression: the dotted-name cache keyed on id(node) alone —
+    CPython recycles ids across differently-lived trees (a rule that
+    re-parses snippets), so a recycled id must never serve another
+    node's cached resolution.  The fix stores the node in the entry
+    (strong ref: a cached id cannot be recycled while the entry lives)
+    and identity-checks on hit."""
+    import ast as ast_mod
+
+    from orion_tpu.analysis.engine import ModuleContext
+
+    src = "import jax\nx = jax.numpy"
+    tree = ast_mod.parse(src)
+    ctx = ModuleContext("x.py", src, tree)
+    node = tree.body[1].value
+    assert ctx.dotted(node) == "jax.numpy"
+    # simulate the recycled-id collision: a foreign node whose id slot
+    # holds another node's cached entry must MISS, not hit
+    foreign = ast_mod.parse("y = torch.numpy").body[0].value
+    ctx._dotted_cache[id(foreign)] = (node, "jax.numpy")
+    assert ctx.dotted(foreign) == "torch.numpy"
+    # and after resolution the entry pins the node it describes
+    entry = ctx._dotted_cache[id(foreign)]
+    assert entry[0] is foreign and entry[1] == "torch.numpy"
+
+
+# ---------------------------------------------------------------------------
+# result cache: correctness before speed
+# ---------------------------------------------------------------------------
+
+
+def test_cache_edit_invalidates_stale_result(tmp_path, capsys):
+    """Edit a file -> its cached per-file result is stale and must not
+    be served; validity is the CONTENT hash, so even an edit that
+    preserves mtime+size semantics (os.utime rollback) invalidates."""
+    from orion_tpu.analysis.__main__ import main
+
+    target = tmp_path / "mod.py"
+    target.write_text("from jax import shard_map\n")
+    cache = tmp_path / "cache.json"
+    assert main(["--cache", str(cache), str(target)]) == 1
+    assert cache.exists()
+    st = os.stat(target)
+    target.write_text(
+        "from orion_tpu.utils.platform import shard_map\n")
+    os.utime(target, (st.st_atime, st.st_mtime))  # mtime rolled back
+    assert main(["--cache", str(cache), str(target)]) == 0
+    capsys.readouterr()
+
+
+def test_cache_reuses_unchanged_results_and_fingerprint_gates(tmp_path):
+    import hashlib
+
+    from orion_tpu.analysis.engine import (ResultCache, analyze_paths,
+                                           ruleset_fingerprint)
+
+    target = tmp_path / "mod.py"
+    target.write_text("from jax import shard_map\n")
+    cache = tmp_path / "cache.json"
+    first = analyze_paths([str(target)], cache_path=str(cache))
+    assert {f.rule_id for f in first} == {"compat-import"}
+    # the entry round-trips bit-identically for unchanged content...
+    rc = ResultCache(str(cache), ruleset_fingerprint(None))
+    sha = hashlib.sha1(target.read_bytes()).hexdigest()
+    hit = rc.get(str(target), sha)
+    assert hit is not None and rc.hits == 1
+    assert [f.key() for f in hit] == [f.key() for f in first]
+    # ...a second full run reports the same findings through the cache
+    again = analyze_paths([str(target)], cache_path=str(cache))
+    assert [f.key() for f in again] == [f.key() for f in first]
+    # ...and a rule-set/package change drops the whole cache
+    stale = ResultCache(str(cache), "different-fingerprint")
+    assert stale.get(str(target), sha) is None
+
+
+# ---------------------------------------------------------------------------
+# CI formats + baseline workflow
+# ---------------------------------------------------------------------------
+
+
+def test_sarif_output_matches_2_1_0_shape(tmp_path, capsys):
+    from orion_tpu.analysis.__main__ import main
+
+    dirty = tmp_path / "dirty.py"
+    dirty.write_text("from jax import shard_map\n")
+    assert main(["--no-cache", "--format", "sarif", str(dirty)]) == 1
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["version"] == "2.1.0"
+    assert "sarif-2.1.0" in doc["$schema"]
+    run = doc["runs"][0]
+    driver = run["tool"]["driver"]
+    assert driver["name"] == "orion-tpu-analysis"
+    assert {r["id"] for r in driver["rules"]} == \
+        {r.id for r in RULES} | {"syntax-error"}
+    assert all(r["shortDescription"]["text"] for r in driver["rules"])
+    res = run["results"][0]
+    assert res["ruleId"] == "compat-import"
+    assert res["level"] == "error"
+    assert res["message"]["text"]
+    loc = res["locations"][0]["physicalLocation"]
+    assert loc["artifactLocation"]["uri"].endswith("dirty.py")
+    assert loc["region"]["startLine"] == 1
+
+
+def test_json_format_and_exit_codes(tmp_path, capsys):
+    from orion_tpu.analysis.__main__ import main
+
+    dirty = tmp_path / "dirty.py"
+    dirty.write_text("from jax import shard_map\n")
+    assert main(["--no-cache", "--format", "json", str(dirty)]) == 1
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["count"] == 1 and doc["baselined"] == 0
+    f = doc["findings"][0]
+    assert f["rule"] == "compat-import" and f["line"] == 1
+    assert f["path"].endswith("dirty.py") and f["hint"]
+    clean = tmp_path / "clean.py"
+    clean.write_text("X = 1\n")
+    assert main(["--no-cache", "--format", "json", str(clean)]) == 0
+    assert json.loads(capsys.readouterr().out)["count"] == 0
+
+
+def test_baseline_warn_first_then_tighten(tmp_path, capsys):
+    """The landing workflow for a new rule: --update-baseline records
+    today's findings, the gate passes on them (exit 0), a NEW finding
+    still gates, and deleting the baseline tightens to the self-gate."""
+    from orion_tpu.analysis.__main__ import main
+
+    dirty = tmp_path / "dirty.py"
+    dirty.write_text("from jax import shard_map\n")
+    bl = tmp_path / "baseline.json"
+    assert main(["--no-cache", "--baseline", str(bl),
+                 "--update-baseline", str(dirty)]) == 0
+    assert "1 finding" in capsys.readouterr().out
+    # baselined: hidden from the gate, surfaced in the summary
+    assert main(["--no-cache", "--baseline", str(bl),
+                 str(dirty)]) == 0
+    assert "baselined" in capsys.readouterr().out
+    # a NEW finding (different rule/message) still gates
+    dirty.write_text("from jax import shard_map\n"
+                     "from jax.lax import axis_size\n")
+    assert main(["--no-cache", "--baseline", str(bl),
+                 str(dirty)]) == 1
+    out = capsys.readouterr().out
+    assert "axis_size" in out and "1 baselined" in out
+    # tighten: no baseline -> both findings gate again
+    assert main(["--no-cache", str(dirty)]) == 1
+    assert "2 findings" in capsys.readouterr().out
+    # a missing baseline file is a usage error, not a silent pass
+    assert main(["--no-cache", "--baseline",
+                 str(tmp_path / "nope.json"), str(dirty)]) == 2
+    capsys.readouterr()
+
+
+def test_list_rules_marks_project_vs_file(capsys):
+    from orion_tpu.analysis.__main__ import main
+
+    assert main(["--list-rules"]) == 0
+    lines = capsys.readouterr().out.splitlines()
+    by_id = {ln.split()[0]: ln for ln in lines if ln.strip()}
+    for rid in ("lock-discipline", "frame-exhaustive", "config-drift"):
+        assert "[project]" in by_id[rid]
+    assert "[file" in by_id["compat-import"]
+    assert "[file" in by_id["unused-suppression"]
+
+
+def test_cache_hit_reanchors_findings_to_invocation_path(
+        tmp_path, monkeypatch):
+    """Regression: cache entries are keyed by abspath but findings
+    stored the invocation-time path SPELLING — a warm hit via a
+    different spelling (relative vs absolute) must re-anchor, or the
+    suppression filter misses its context and a justified suppression
+    both resurfaces its finding AND reads as stale."""
+    from orion_tpu.analysis.engine import analyze_paths
+
+    mod = tmp_path / "mod.py"
+    mod.write_text(
+        "import socket\n\n"
+        "def dial(p):\n"
+        "    return socket.create_connection(('h', p))"
+        "  # orion: ignore[raw-socket] test probe\n")
+    cache = tmp_path / "c.json"
+    monkeypatch.chdir(tmp_path)
+    assert analyze_paths(["mod.py"], cache_path=str(cache)) == []
+    assert analyze_paths([str(mod)], cache_path=str(cache)) == []
+
+
+def test_bare_stale_suppression_is_itself_reported():
+    """Regression: a bracketless ignore must not silence its OWN
+    staleness verdict — it silences every rule except
+    unused-suppression (which only fires when nothing else does)."""
+    hits = run_on("X = 1  # orion: ignore\n")
+    assert ids_of(hits) == {"unused-suppression"}
+
+
+def test_malformed_baseline_is_usage_error_not_crash(tmp_path, capsys):
+    """A hand-edited baseline entry missing its keys must exit 2 with
+    a message, never escape as a KeyError traceback CI reads as
+    'findings found'."""
+    from orion_tpu.analysis.__main__ import main
+
+    target = tmp_path / "mod.py"
+    target.write_text("X = 1\n")
+    bad = tmp_path / "bad.json"
+    bad.write_text('{"findings": [{"rule": "x"}]}')
+    assert main(["--no-cache", "--baseline", str(bad),
+                 str(target)]) == 2
+    assert "unreadable baseline" in capsys.readouterr().err
+
+
+def test_baseline_counts_occurrences_and_normalizes_paths(
+        tmp_path, capsys, monkeypatch):
+    """Regressions: (1) one baselined entry must not silently absorb a
+    SECOND identical violation — matching is count-based; (2) baseline
+    keys are cwd-relative, so a baseline written via a relative path
+    matches an absolute invocation of the same file."""
+    from orion_tpu.analysis.__main__ import main
+
+    monkeypatch.chdir(tmp_path)
+    dirty = tmp_path / "dirty.py"
+    dirty.write_text("from jax import shard_map\n")
+    assert main(["--no-cache", "--baseline", "b.json",
+                 "--update-baseline", "dirty.py"]) == 0
+    # absolute spelling of the same file: still baselined
+    assert main(["--no-cache", "--baseline", "b.json",
+                 str(dirty)]) == 0
+    # a second IDENTICAL violation (same rule+path+message, new line)
+    # exceeds the recorded count and gates
+    dirty.write_text("from jax import shard_map\n"
+                     "from jax import shard_map\n")
+    assert main(["--no-cache", "--baseline", "b.json",
+                 "dirty.py"]) == 1
+    out = capsys.readouterr().out
+    assert "1 finding" in out and "1 baselined" in out
+
+
+def test_config_drift_method_wiring_is_order_independent():
+    """Regression: a knob read only by a helper DEFINED BEFORE the
+    externally-called method that delegates to it must still count as
+    wired (fixpoint, not single definition-order pass)."""
+    files = {
+        "myconfig.py": """
+        import dataclasses
+
+        @dataclasses.dataclass
+        class RetryConfig:
+            max_tries: int = 3
+
+            def _policy_impl(self):
+                return self.max_tries * 2
+
+            def retry_policy(self):
+                return self._policy_impl()
+        """,
+        "caller.py": """
+        def go(cfg):
+            return cfg.retry_policy()
+        """,
+    }
+    assert "config-drift" not in ids_of(run_on_files(files))
+
+
+def test_frame_exhaustive_universe_is_module_scoped():
+    """Regression: a module fully dispatching its OWN frame family
+    must not fail against another module's frames — the missing-set is
+    judged per module (frames it defines/imports/mentions), so a
+    second family (the streaming-gateway direction) can land without
+    poisoning every existing chain."""
+    files = {
+        "remote.py": """
+        FRAME_DATA = 0
+        FRAME_HELLO = 1
+        FRAME_TRAJ = 2
+        """,
+        "gateway.py": """
+        STREAM_OPEN = 0
+
+        FRAME_X = 10
+        FRAME_Y = 11
+
+        def dispatch(kind):
+            if kind == FRAME_X:
+                return 1
+            elif kind == FRAME_Y:
+                return 2
+        """,
+    }
+    assert "frame-exhaustive" not in ids_of(run_on_files(files))
+    # ...but dropping one of the module's OWN frames still fires
+    files["gateway.py"] = files["gateway.py"].replace(
+        "FRAME_Y = 11", "FRAME_Y = 11\n        FRAME_Z = 12")
+    hits = [f for f in run_on_files(files)
+            if f.rule_id == "frame-exhaustive"]
+    assert hits and "FRAME_Z" in hits[0].message
+
+
+def test_syntax_error_survives_rule_filter(tmp_path, capsys):
+    """Regression: a --rule-filtered gate must never report clean on a
+    file it could not even parse."""
+    from orion_tpu.analysis.__main__ import main
+
+    bad = tmp_path / "broken.py"
+    bad.write_text("def f(:\n")
+    assert main(["--no-cache", "--rule", "raw-socket",
+                 str(bad)]) == 1
+    assert "syntax-error" in capsys.readouterr().out
+
+
+def test_string_literal_marker_neither_suppresses_nor_audits():
+    """Regression: is_suppressed and the unused-suppression sweep now
+    share the tokenized comment map — a marker inside a string
+    literal is prose on BOTH sides: it cannot swallow a real finding,
+    and it is never judged stale."""
+    src = """
+    import socket
+
+    def dial(p):
+        return socket.create_connection(("h", p)), "# orion: ignore"
+    """
+    got = ids_of(run_on(src, "orion_tpu/fake_io.py"))
+    assert "raw-socket" in got          # the string did not suppress
+    assert "unused-suppression" not in got
+
+
+def test_cache_sections_let_rule_selections_coexist(tmp_path):
+    """Regression: alternating full-registry and --rule invocations
+    share one cache file via per-fingerprint sections instead of
+    wholesale-evicting each other."""
+    import hashlib
+
+    from orion_tpu.analysis.engine import (ResultCache, analyze_paths,
+                                           ruleset_fingerprint)
+
+    target = tmp_path / "mod.py"
+    target.write_text("from jax import shard_map\n")
+    cache = tmp_path / "c.json"
+    only = [r for r in RULES if r.id == "raw-socket"]
+    analyze_paths([str(target)], cache_path=str(cache))          # full
+    analyze_paths([str(target)], rules=only, cache_path=str(cache))
+    sha = hashlib.sha1(target.read_bytes()).hexdigest()
+    rc_full = ResultCache(str(cache), ruleset_fingerprint(None))
+    rc_rule = ResultCache(str(cache), ruleset_fingerprint(only))
+    assert rc_full.get(str(target), sha) is not None
+    assert rc_rule.get(str(target), sha) is not None
+
+
+def test_non_dict_baseline_is_usage_error(tmp_path, capsys):
+    from orion_tpu.analysis.__main__ import main
+
+    target = tmp_path / "mod.py"
+    target.write_text("X = 1\n")
+    bad = tmp_path / "bl.json"
+    bad.write_text("[]")
+    assert main(["--no-cache", "--baseline", str(bad),
+                 str(target)]) == 2
+    assert "unreadable baseline" in capsys.readouterr().err
+
+
+def test_overlapping_paths_do_not_duplicate_project_modules(tmp_path):
+    """Regression: a dir plus a file inside it must analyze the file
+    ONCE — a duplicated module makes every lock-owning class's methods
+    ambiguously owned, silently disabling thread-entry resolution."""
+    from orion_tpu.analysis.engine import iter_python_files
+
+    mod = tmp_path / "pool.py"
+    mod.write_text("X = 1\n")
+    files = list(iter_python_files([str(tmp_path), str(mod)]))
+    assert len(files) == 1
+
+
+def test_lock_discipline_sees_annotated_lock_assignment():
+    """Regression: `self._lock: threading.Lock = threading.Lock()`
+    must register lock ownership exactly like the bare assignment."""
+    src = """
+    import queue
+    import threading
+
+    class Pool:
+        def __init__(self):
+            self._lock: threading.Lock = threading.Lock()
+            self.alive = True
+            self.inbox = queue.Queue()
+            self._t = threading.Thread(target=self._recv_loop)
+
+        def consume(self):
+            with self._lock:
+                if self.alive:
+                    return self.inbox.get_nowait()
+                return None
+
+        def shutdown(self):
+            with self._lock:
+                self.alive = False
+
+        def _recv_loop(self):
+            while self.alive:
+                self.inbox.put(1)
+    """
+    assert "lock-discipline" in ids_of(run_on(src, "pool.py"))
+
+
+def test_frame_exhaustive_credits_else_with_nested_if():
+    """Regression: an `else:` whose body is one nested `if` that
+    raises/logs is a loud catch-all, not a silent elif — col_offset
+    distinguishes it from a real elif."""
+    src = """
+    import logging
+
+    FRAME_A = 0
+    FRAME_B = 1
+    FRAME_C = 2
+
+    def dispatch(kind):
+        if kind == FRAME_A:
+            return 1
+        elif kind == FRAME_B:
+            return 2
+        else:
+            if kind != 99:
+                logging.getLogger(__name__).warning(
+                    "unexpected frame %s", kind)
+    """
+    assert "frame-exhaustive" not in ids_of(run_on(src, "wire.py"))
+
+
+def test_frame_exhaustive_counts_renamed_imports():
+    """Regression: `from remote import FRAME_C as GOODBYE` still owes
+    FRAME_C a branch — the local universe resolves alias TARGETS."""
+    files = {
+        "remote.py": """
+        FRAME_A = 0
+        FRAME_B = 1
+        FRAME_C = 2
+        """,
+        "client.py": """
+        from remote import FRAME_A, FRAME_B
+        from remote import FRAME_C as GOODBYE
+
+        def dispatch(kind):
+            if kind == FRAME_A:
+                return 1
+            elif kind == FRAME_B:
+                return 2
+        """,
+    }
+    hits = [f for f in run_on_files(files)
+            if f.rule_id == "frame-exhaustive"]
+    assert hits and "FRAME_C" in hits[0].message
+
+
+def test_lock_discipline_trusts_caller_held_helpers():
+    """Regression: a helper only ever called with the lock held (the
+    _mark_dead style) must not be flagged — nor may the exemption
+    leak to a helper that ALSO has a bare call site."""
+    base = """
+    import threading
+
+    class Pool:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.count = 0
+            self._t = threading.Thread(target=self._loop)
+
+        def read(self):
+            with self._lock:
+                return self.count
+
+        def snap(self):
+            with self._lock:
+                return self.count + 1
+
+        def _bump(self):
+            self.count += 1
+
+        def _loop(self):
+            with self._lock:
+                self._bump()
+    """
+    assert "lock-discipline" not in ids_of(run_on(base, "pool.py"))
+    leaky = base.replace(
+        "            with self._lock:\n                self._bump()",
+        "            with self._lock:\n                self._bump()\n"
+        "            self._bump()")
+    assert "lock-discipline" in ids_of(run_on(leaky, "pool.py"))
+
+
+def test_config_drift_store_only_knob_is_unwired():
+    """Regression: `cfg.knob = 5` is a STORE — it must not count as
+    the read that wires a knob."""
+    files = {
+        "myconfig.py": """
+        import dataclasses
+
+        @dataclasses.dataclass
+        class ServeConfig:
+            write_only: int = 0
+        """,
+        "launch.py": """
+        def wire(cfg):
+            cfg.write_only = 5
+        """,
+    }
+    hits = [f for f in run_on_files(files)
+            if f.rule_id == "config-drift"]
+    assert hits and "write_only" in hits[0].message
+
+
+def test_sarif_declares_syntax_error_rule(tmp_path, capsys):
+    from orion_tpu.analysis.__main__ import main
+
+    bad = tmp_path / "broken.py"
+    bad.write_text("def f(:\n")
+    assert main(["--no-cache", "--format", "sarif", str(bad)]) == 1
+    doc = json.loads(capsys.readouterr().out)
+    run = doc["runs"][0]
+    declared = {r["id"] for r in run["tool"]["driver"]["rules"]}
+    assert {r["ruleId"] for r in run["results"]} <= declared
+
+
+def test_baseline_matches_across_invoking_cwds(tmp_path, capsys,
+                                               monkeypatch):
+    """Regression: baseline keys anchor to the BASELINE FILE's
+    directory, so a baseline written from one cwd keeps matching when
+    the gate later runs from a subdirectory."""
+    from orion_tpu.analysis.__main__ import main
+
+    dirty = tmp_path / "dirty.py"
+    dirty.write_text("from jax import shard_map\n")
+    sub = tmp_path / "sub"
+    sub.mkdir()
+    monkeypatch.chdir(tmp_path)
+    assert main(["--no-cache", "--baseline", "b.json",
+                 "--update-baseline", "dirty.py"]) == 0
+    monkeypatch.chdir(sub)
+    assert main(["--no-cache", "--baseline", "../b.json",
+                 "../dirty.py"]) == 0
+    capsys.readouterr()
+
+
+def test_lock_alias_keyword_condition_form():
+    """Regression: `threading.Condition(lock=self._lock)` aliases the
+    lock exactly like the positional form — the per-lock evidence must
+    not split across two names."""
+    src = """
+    import threading
+
+    class Box:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._cv = threading.Condition(lock=self._lock)
+            self.n = 0
+            self._t = threading.Thread(target=self._loop)
+
+        def read(self):
+            with self._cv:
+                return self.n
+
+        def bump(self):
+            with self._lock:
+                self.n += 1
+
+        def _loop(self):
+            while self.n < 3:
+                pass
+    """
+    assert "lock-discipline" in ids_of(run_on(src, "box.py"))
+
+
+def test_fingerprint_is_rule_order_independent():
+    from orion_tpu.analysis.engine import ruleset_fingerprint
+
+    a = [r for r in RULES if r.id in ("raw-socket", "naked-timer")]
+    assert ruleset_fingerprint(a) == \
+        ruleset_fingerprint(list(reversed(a)))
+
+
+def test_baseline_never_absorbs_syntax_errors(tmp_path, capsys,
+                                              monkeypatch):
+    """Regression: an unparsable file must gate even when its finding
+    was present at --update-baseline time — a baselined gate must
+    never stay green on a file that does not parse."""
+    from orion_tpu.analysis.__main__ import main
+
+    monkeypatch.chdir(tmp_path)
+    bad = tmp_path / "broken.py"
+    bad.write_text("def f(:\n")
+    assert main(["--no-cache", "--baseline", "b.json",
+                 "--update-baseline", "broken.py"]) == 0
+    assert main(["--no-cache", "--baseline", "b.json",
+                 "broken.py"]) == 1
+    assert "syntax-error" in capsys.readouterr().out
+
+
+def test_header_history_lookup_is_name_tied():
+    """Regression: an unrelated *_HISTORY dict in the same module must
+    not clobber the header's own table."""
+    src = """
+    import struct
+
+    PROTOCOL_VERSION = 2
+    _HEADER = struct.Struct(">4sHB")
+    _HEADER_HISTORY = {1: ">4sH", 2: ">4sHB"}
+    _RETRY_HISTORY = {1: "connect"}
+    """
+    assert "frame-exhaustive" not in ids_of(run_on(src, "wire.py"))
+
+
+def test_malformed_cache_entry_degrades_to_miss(tmp_path):
+    from orion_tpu.analysis.engine import (ResultCache, analyze_paths,
+                                           ruleset_fingerprint)
+
+    target = tmp_path / "mod.py"
+    target.write_text("from jax import shard_map\n")
+    cache = tmp_path / "c.json"
+    analyze_paths([str(target)], cache_path=str(cache))
+    # corrupt the per-file entry but keep valid JSON + sections shape
+    fp = ruleset_fingerprint(None)
+    cache.write_text(json.dumps(
+        {"sections": {fp: {str(target).replace(os.sep, "/"):
+                           "not-a-dict"}}}))
+    findings = analyze_paths([str(target)], cache_path=str(cache))
+    assert {f.rule_id for f in findings} == {"compat-import"}
+
+
+def test_cache_is_path_spelling_scoped(tmp_path, monkeypatch):
+    """Regression: rule output depends on the path SPELLING (test/obs
+    exemptions), so a cache entry for one spelling must never serve
+    another — here the same bytes are exempt as `tests/x.py` but must
+    still fire as `pkg/x.py`."""
+    from orion_tpu.analysis.engine import analyze_paths
+
+    (tmp_path / "tests").mkdir()
+    (tmp_path / "pkg").mkdir()
+    snippet = ("import time\n\n"
+               "def measure(f):\n"
+               "    t0 = time.monotonic()\n"
+               "    f()\n"
+               "    return time.monotonic() - t0\n")
+    (tmp_path / "tests" / "x.py").write_text(snippet)
+    (tmp_path / "pkg" / "x.py").write_text(snippet)
+    cache = tmp_path / "c.json"
+    monkeypatch.chdir(tmp_path)
+    assert analyze_paths(["tests/x.py"],
+                         cache_path=str(cache)) == []
+    hits = analyze_paths(["pkg/x.py"], cache_path=str(cache))
+    assert "naked-timer" in {f.rule_id for f in hits}
+
+
+def test_lock_discipline_flags_wrong_lock_access():
+    """Regression: an access under a DIFFERENT lock than the guarding
+    one is no mutual exclusion — 'some lock held' must not pass."""
+    src = """
+    import threading
+
+    class Box:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._other = threading.Lock()
+            self.count = 0
+            self._t = threading.Thread(target=self.run)
+
+        def read(self):
+            with self._lock:
+                return self.count
+
+        def snap(self):
+            with self._lock:
+                return self.count + 1
+
+        def run(self):
+            with self._other:
+                self.count += 1
+    """
+    hits = [f for f in run_on(src, "box.py")
+            if f.rule_id == "lock-discipline"]
+    assert hits and "DIFFERENT" in hits[0].message
+
+
+def test_config_drift_annotated_module_constant_is_legal():
+    """Regression: `NAME: dict = {...}` at config-module top level is
+    a legal `config.NAME` read target (AnnAssign, not just Assign)."""
+    files = {
+        "myconfig.py": """
+        import dataclasses
+
+        DEFAULT_PROFILES: dict = {"a": 1}
+
+        @dataclasses.dataclass
+        class ServeConfig:
+            port: int = 0
+        """,
+        "server.py": """
+        from myproj import myconfig as config
+
+        def serve(cfg):
+            return cfg.port, config.DEFAULT_PROFILES
+        """,
+    }
+    assert "config-drift" not in ids_of(run_on_files(files))
+
+
+def test_malformed_history_key_reports_not_crashes():
+    """Regression: a string-key typo in the history table must yield a
+    finding, never a TypeError out of the analyzer."""
+    src = """
+    import struct
+
+    PROTOCOL_VERSION = 4
+    _HEADER = struct.Struct(">4sHBQQQ")
+    _HEADER_HISTORY = {"3": ">4sHBQ", 4: ">4sHBQQQ"}
+    """
+    run_on(src, "wire.py")  # must not raise
+    src2 = src.replace('4: ">4sHBQQQ"', '"4": ">4sHBQQQ"')
+    hits = [f for f in run_on(src2, "wire.py")
+            if f.rule_id == "frame-exhaustive"]
+    assert hits  # all entries malformed -> format unregistered
+
+
+def test_corrupt_cache_section_degrades_to_miss(tmp_path):
+    """Regression: a non-dict SECTION value (hand edit / disk
+    corruption) must degrade to a cold run, never a traceback."""
+    from orion_tpu.analysis.engine import (analyze_paths,
+                                           ruleset_fingerprint)
+
+    target = tmp_path / "mod.py"
+    target.write_text("from jax import shard_map\n")
+    cache = tmp_path / "c.json"
+    fp = ruleset_fingerprint(None)
+    cache.write_text(json.dumps({"sections": {fp: [1, 2, 3]}}))
+    findings = analyze_paths([str(target)], cache_path=str(cache))
+    assert {f.rule_id for f in findings} == {"compat-import"}
+    # and the corrupt section did not round-trip
+    data = json.loads(cache.read_text())
+    assert isinstance(data["sections"][fp], dict)
+
+
+def test_unwritable_baseline_path_is_usage_error(tmp_path, capsys):
+    from orion_tpu.analysis.__main__ import main
+
+    target = tmp_path / "mod.py"
+    target.write_text("X = 1\n")
+    missing = tmp_path / "nodir" / "b.json"
+    assert main(["--no-cache", "--baseline", str(missing),
+                 "--update-baseline", str(target)]) == 2
+    assert "cannot write baseline" in capsys.readouterr().err
+
+
+def test_cyclic_config_inheritance_degrades_not_crashes():
+    """Regression: statically-cyclic *Config bases (a typo'd base on
+    WIP code parses fine) must not RecursionError the gate."""
+    files = {
+        "myconfig.py": """
+        import dataclasses
+
+        @dataclasses.dataclass
+        class AConfig(BConfig):
+            x: int = 0
+
+        @dataclasses.dataclass
+        class BConfig(AConfig):
+            y: int = 0
+
+        @dataclasses.dataclass
+        class TopConfig:
+            sub: AConfig = dataclasses.field(default_factory=AConfig)
+        """,
+        "server.py": """
+        def go(cfg):
+            return cfg.sub.x + cfg.sub.y + cfg.sub.x
+        """,
+    }
+    run_on_files(files)  # must not raise
+
+
+def test_every_header_is_validated_not_just_the_last():
+    """Regression: a second wire header later in the module must not
+    mask the first header's unbumped format edit."""
+    src = """
+    import struct
+
+    PROTOCOL_VERSION = 4
+    _HEADER = struct.Struct(">4sHBQQQ")
+    _HEADER_HISTORY = {4: ">4sHBQ"}
+
+    _DIAG_HEADER = struct.Struct(">4sH")
+    _DIAG_HEADER_HISTORY = {4: ">4sH"}
+    """
+    hits = [f for f in run_on(src, "wire.py")
+            if f.rule_id == "frame-exhaustive"]
+    assert hits and "_HEADER pack format" in hits[0].message
+
+
+def test_cache_prune_bounds_growth_without_subset_wipe(tmp_path):
+    """Regression pair: stale one-off entries are shed past the bound,
+    but an ad-hoc single-file run must not wipe a full-tree section."""
+    from orion_tpu.analysis.engine import ResultCache
+
+    rc = ResultCache(str(tmp_path / "c.json"), "fp")
+    for i in range(1030):
+        rc.put(f"gone/{i}.py", "sha", [])
+    rc.put("keep.py", "sha", [])
+    rc.prune(["keep.py"])                    # over the bound: shed
+    assert len(rc._files) == 1024 and "keep.py" in rc._files
+    small = ResultCache(str(tmp_path / "d.json"), "fp")
+    for i in range(50):
+        small.put(f"tree/{i}.py", "sha", [])
+    small.prune(["tree/0.py"])               # under the bound: keep
+    assert len(small._files) == 50
+
+
+def test_no_project_flag_enables_partial_path_runs(capsys):
+    """A single-file run of config.py would flag every knob whose
+    reader lives elsewhere; --no-project withholds project findings
+    (while still judging project-id suppressions correctly)."""
+    from orion_tpu.analysis.__main__ import main
+
+    cfg = os.path.join(REPO, "orion_tpu", "config.py")
+    assert main(["--no-cache", cfg]) == 1        # scoped noise
+    assert "config-drift" in capsys.readouterr().out
+    assert main(["--no-cache", "--no-project", cfg]) == 0
+    capsys.readouterr()
+
+
+def test_no_project_with_project_only_rule_is_usage_error(tmp_path):
+    """`--no-project --rule lock-discipline` would check nothing — a
+    run that checks nothing must not report clean."""
+    from orion_tpu.analysis.__main__ import main
+
+    target = tmp_path / "mod.py"
+    target.write_text("X = 1\n")
+    with pytest.raises(SystemExit) as exc:
+        main(["--no-cache", "--no-project",
+              "--rule", "lock-discipline", str(target)])
+    assert exc.value.code == 2
+
+
+def test_bytes_struct_format_headers_pass():
+    """Regression: struct.Struct accepts bytes formats — a matching
+    bytes header/history pair must pass, mixed str/bytes too."""
+    src = """
+    import struct
+
+    PROTOCOL_VERSION = 2
+    _HEADER = struct.Struct(b">4sHB")
+    _HEADER_HISTORY = {1: ">4sH", 2: b">4sHB"}
+    """
+    assert "frame-exhaustive" not in ids_of(run_on(src, "wire.py"))
+
+
+def test_is_test_path_matches_segments_not_substrings():
+    from orion_tpu.analysis.engine import is_test_path
+
+    assert is_test_path("tests/test_x.py")
+    assert is_test_path("pkg/tests/helper.py")
+    assert is_test_path("conftest.py")
+    assert not is_test_path("orion_tpu/backtests/driver.py")
+    assert not is_test_path("orion_tpu/contests.py")
