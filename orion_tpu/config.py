@@ -315,6 +315,15 @@ class RolloutConfig:
     # watermark makes preemption rare instead of structural).
     # -1 = auto: one page per engine slot.
     page_watermark: int = -1
+    # -- multi-tenant serving QoS (PR 12) ------------------------------
+    # Global admission-queue watermark: a submit() that would leave
+    # more than this many requests WAITING (unadmitted) is refused
+    # with a typed EngineOverloaded carrying queue depth + a
+    # retry-after hint, instead of growing the queue without bound
+    # under overload.  0 = unlimited (the trainer path, where the
+    # caller owns the arrival rate).  Per-tenant caps/rate limits are
+    # registered at runtime via engine.configure_tenant().
+    max_queued_requests: int = 0
     # Waves between a slot's done-flag snapshot and its harvest.
     # 1 lets the flag fetch ride out the next segment's execution —
     # worth a full tunnel RTT per wave on a remote TPU link, but pure
@@ -393,6 +402,10 @@ class RolloutConfig:
             raise ValueError(
                 f"chunked_prefill_tokens must be >= 0 (0 disables), got "
                 f"{self.chunked_prefill_tokens}")
+        if self.max_queued_requests < 0:
+            raise ValueError(
+                f"max_queued_requests must be >= 0 (0 = unlimited), "
+                f"got {self.max_queued_requests}")
         if self.page_watermark < -1:
             raise ValueError(
                 f"page_watermark must be >= -1 (-1 = auto), got "

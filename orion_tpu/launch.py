@@ -1,17 +1,28 @@
-"""Training entrypoint (SURVEY.md §2 #16, layer map "CLI / launch").
+"""Training + serving entrypoint (SURVEY.md §2 #16, layer map
+"CLI / launch").
 
 Usage:
   python -m orion_tpu.launch <algo> [--config cfg.yaml] [key=value ...]
-  algo ∈ {ppo, grpo, rloo, online_dpo}
+  algo ∈ {ppo, grpo, rloo, online_dpo, serve}
 
-Cross-process rollout pool (PR 10, ROADMAP item 1 leftover): with
-``async_mode=true resilience.pool_size=N`` (N > 0) the launcher itself
-spawns N rollout worker PROCESSES — each re-execs this entrypoint with
-the same config plus ``ORION_POOL_WORKER_PORT``/``_RANK`` env routing
-it into :func:`run_pool_worker` — and trains through
-``PoolOrchestrator`` (elastic membership, per-worker heartbeats,
-dead-worker discard; see orchestration/remote.py).  ``pool_size=0``
-(default) keeps async mode on the in-process rollout thread.
+Cross-process rollout pool (PR 10): with ``async_mode=true
+resilience.pool_size=N`` (N > 0) the launcher itself spawns N rollout
+worker PROCESSES — each re-execs this entrypoint with the same config
+plus ``ORION_POOL_WORKER_PORT``/``_RANK`` env routing it into
+:func:`run_pool_worker` — and trains through ``PoolOrchestrator``
+(elastic membership, per-worker heartbeats, dead-worker discard; see
+orchestration/remote.py).  ``pool_size=0`` (default) keeps async mode
+on the in-process rollout thread.
+
+Serving gateway (PR 12, ROADMAP item 1 shipped-core):
+``python -m orion_tpu.launch serve [--port N] [--tenants SPEC]
+[key=value ...]`` builds the continuous engine from the same config
+surface (``rollout.*``, ``hf_path``/``model_preset``) through the same
+engine construction the pool workers use, and fronts it with a
+:class:`~orion_tpu.orchestration.gateway.ServingGateway` — remote
+clients submit/stream/cancel over the framed ``ORTP`` channel, with
+per-tenant QoS from ``--tenants "paid:weight=4,rate=100;free:..."``.
+SIGTERM/SIGINT drain through the preemption handler (exit 0).
 
 Examples (the five SPEC configs, BASELINE.json):
   # 5: GRPO math with rule-based reward, fully offline
@@ -156,6 +167,28 @@ def build_reward(cfg, tokenizer, mesh):
     raise ValueError(f"unknown reward spec: {spec!r}")
 
 
+def build_rollout_engine(cfg, tokenizer):
+    """The policy decode engine a non-learner process runs: shared by
+    the pool workers (PR 10) and the serving gateway (PR 12), so both
+    speak the same ``rollout.*`` config surface.  Returns (engine,
+    eos_id, pad_id)."""
+    from orion_tpu.rollout import RolloutEngine
+
+    eos = getattr(tokenizer, "eos_token_id", None)
+    pad = getattr(tokenizer, "pad_token_id", 0) or 0
+    model = Transformer(cfg.model)
+    if cfg.rollout.engine == "continuous":
+        from orion_tpu.rollout.continuous import ContinuousBatchingEngine
+
+        engine = ContinuousBatchingEngine(
+            model, cfg.model, cfg.rollout, eos_token_id=eos,
+            pad_token_id=pad, segment_len=cfg.rollout.segment_len)
+    else:
+        engine = RolloutEngine(model, cfg.model, cfg.rollout,
+                               eos_token_id=eos, pad_token_id=pad)
+    return engine, eos, pad
+
+
 def run_pool_worker(cfg, port: int, rank: int,
                     host: str = "localhost",
                     n_batches: Optional[int] = None) -> int:
@@ -172,24 +205,12 @@ def run_pool_worker(cfg, port: int, rank: int,
 
     from orion_tpu.orchestration.remote import PoolWorkerClient
     from orion_tpu.resilience.preemption import install_handler
-    from orion_tpu.rollout import RolloutEngine
     from orion_tpu.trainers.base import dispatch_generate_batch
 
     tokenizer = load_tokenizer(cfg.data.tokenizer)
     if cfg.data.tokenizer in (None, "byte"):
         cfg.model.vocab_size = max(cfg.model.vocab_size, 260)
-    eos = getattr(tokenizer, "eos_token_id", None)
-    pad = getattr(tokenizer, "pad_token_id", 0) or 0
-    model = Transformer(cfg.model)
-    if cfg.rollout.engine == "continuous":
-        from orion_tpu.rollout.continuous import ContinuousBatchingEngine
-
-        engine = ContinuousBatchingEngine(
-            model, cfg.model, cfg.rollout, eos_token_id=eos,
-            pad_token_id=pad, segment_len=cfg.rollout.segment_len)
-    else:
-        engine = RolloutEngine(model, cfg.model, cfg.rollout,
-                               eos_token_id=eos, pad_token_id=pad)
+    engine, eos, pad = build_rollout_engine(cfg, tokenizer)
     # Model-backed rewards shard on this process's own local mesh;
     # host rewards (math/length) never touch one.
     mesh = (make_mesh(cfg.mesh)
@@ -246,6 +267,60 @@ def run_pool_worker(cfg, port: int, rank: int,
         cfg.resilience, port, host=host,
         name=f"launch-worker-{rank}", seed=cfg.seed + rank)
     return client.run(gen, n_batches=n_batches, preemption=handler)
+
+
+def run_serve(cfg, port: int = 0, tenant_spec: Optional[str] = None,
+              host: str = "localhost", stop=None,
+              on_ready=None) -> Any:
+    """Serving-gateway process body (PR 12): the continuous engine as
+    a network service.  Builds the engine through the same machinery
+    the pool workers use (:func:`build_rollout_engine`), loads weights
+    (HF checkpoint via ``hf_path`` or a seeded random init), fronts it
+    with a :class:`ServingGateway`, and pumps until ``stop`` fires or
+    SIGTERM/SIGINT arrives (graceful drain, exit 0).
+
+    ``on_ready(gateway)`` is the in-process harness hook (the tier-1
+    smoke learns the ephemeral port from it); ``stop`` is any object
+    with ``is_set()``."""
+    import threading
+
+    from orion_tpu.models import init_params
+    from orion_tpu.orchestration.gateway import (ServingGateway,
+                                                 parse_tenant_spec)
+    from orion_tpu.resilience.preemption import install_handler
+
+    tokenizer = load_tokenizer(cfg.data.tokenizer)
+    if cfg.data.tokenizer in (None, "byte"):
+        cfg.model.vocab_size = max(cfg.model.vocab_size, 260)
+    if cfg.rollout.engine != "continuous":
+        # Streaming delivery and tenant QoS live on the continuous
+        # engine's submit/step surface; serving never uses the
+        # fixed-batch engine.
+        cfg.rollout.engine = "continuous"
+    engine, _eos, _pad = build_rollout_engine(cfg, tokenizer)
+    if cfg.hf_path:
+        params = load_hf_pretrained(cfg.hf_path, cfg.model)
+        params = jax.device_put(params)
+    else:
+        params = init_params(Transformer(cfg.model),
+                             jax.random.key(cfg.seed), cfg.model)
+    engine.load_weights(params)
+    engine.reset_rng(jax.random.key(cfg.seed + 1))
+    tenants = parse_tenant_spec(tenant_spec) if tenant_spec else None
+    gw = ServingGateway(engine, port=port, host=host, tenants=tenants)
+    handler = None
+    if threading.current_thread() is threading.main_thread():
+        handler = install_handler()
+    print(f"[serve] gateway listening on {host}:{gw.port} "
+          f"(engine slots={engine.slots}, pages={engine.num_pages})",
+          flush=True)
+    if on_ready is not None:
+        on_ready(gw)
+    try:
+        gw.serve_forever(stop=stop, preemption=handler)
+    finally:
+        gw.close()
+    return gw.stats
 
 
 def spawn_pool_workers(algo: str, argv: list, port: int, n: int) -> list:
@@ -331,8 +406,9 @@ def build_trainer(algo: str, cfg, mesh, tokenizer):
 
 def main(argv: Optional[list] = None) -> Any:
     argv = list(sys.argv[1:] if argv is None else argv)
-    if not argv or argv[0] not in ALGOS:
-        print(f"usage: python -m orion_tpu.launch {{{'|'.join(ALGOS)}}} "
+    if not argv or (argv[0] not in ALGOS and argv[0] != "serve"):
+        print(f"usage: python -m orion_tpu.launch "
+              f"{{{'|'.join(ALGOS)}|serve}} "
               "[--config cfg.yaml] [key=value ...]", file=sys.stderr)
         raise SystemExit(2)
     algo = argv.pop(0)
@@ -342,10 +418,25 @@ def main(argv: Optional[list] = None) -> Any:
         i = argv.index("--config")
         yaml_path = argv[i + 1]
         del argv[i:i + 2]
-    cfg_cls, _ = ALGOS[algo]
+    serve_port, tenant_spec = 0, None
+    if algo == "serve":
+        if "--port" in argv:
+            i = argv.index("--port")
+            serve_port = int(argv[i + 1])
+            del argv[i:i + 2]
+        if "--tenants" in argv:
+            i = argv.index("--tenants")
+            tenant_spec = argv[i + 1]
+            del argv[i:i + 2]
+    cfg_cls, _ = ALGOS.get(algo, (GRPOConfig, None))
     cfg = load_config(cfg_cls, yaml_path=yaml_path, cli_args=argv)
     if cfg.model_preset:
         cfg.model = getattr(ModelConfig, cfg.model_preset)()
+
+    if algo == "serve":
+        return run_serve(cfg, port=serve_port, tenant_spec=tenant_spec,
+                         host=os.environ.get("ORION_SERVE_HOST",
+                                             "localhost"))
 
     # Rollout-worker process (spawned by the pool branch below): the
     # env routing keeps the CLI surface unchanged — a worker re-parses
@@ -402,7 +493,7 @@ def main(argv: Optional[list] = None) -> Any:
             data_dir=cfg.data.data_dir)
 
     if cfg.async_mode and cfg.resilience.pool_size > 0:
-        # Cross-process rollout pool (ROADMAP item 1 leftover): the
+        # Cross-process rollout pool (PR 10): the
         # launcher spawns resilience.pool_size worker processes itself
         # — each re-execs this entrypoint with the same args plus the
         # ORION_POOL_WORKER_* env routing — and trains through

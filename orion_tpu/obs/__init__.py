@@ -31,7 +31,10 @@ from __future__ import annotations
 from typing import Any, Dict, Optional
 
 from orion_tpu.obs.flightrec import FlightRecorder  # noqa: F401
-from orion_tpu.obs.telemetry import RequestTelemetry  # noqa: F401
+from orion_tpu.obs.telemetry import (  # noqa: F401
+    RequestTelemetry,
+    TokenBucket,
+)
 from orion_tpu.obs.trace import (  # noqa: F401
     Span,
     Tracer,

@@ -1,5 +1,5 @@
 """Request-lifecycle telemetry for the serving engine (ISSUE 9
-tentpole (c)).
+tentpole (c); per-tenant SLO accounting added by ISSUE 12).
 
 The continuous engine's host loop knows every lifecycle transition —
 submit, admit, first token, preempt, finish — but until now it only
@@ -15,7 +15,15 @@ analysis rule bans raw ``time.*`` deltas outside ``orion_tpu/obs/``):
   decode tokens/sec — whose p50/p95/p99 summaries flow through
   ``MetricsWriter`` and the serving bench JSON;
 - per-wave gauges (page-pool occupancy) and per-admission ratios
-  (prefix-cache hit fraction) ride the same histogram machinery.
+  (prefix-cache hit fraction) ride the same histogram machinery;
+- a ``submit`` mark carrying ``tenant=<name>`` additionally routes the
+  request's queue-wait/TTFT into PER-TENANT histograms surfaced as
+  ``tenant_<name>_<metric>`` keys (the multi-tenant SLO ledger: the
+  overload bench asserts the paying tenant's p95 TTFT against these),
+  and :meth:`record_shed` counts refused admissions per tenant.
+
+:class:`TokenBucket` lives here too: the per-tenant rate limiter is
+clock-owning code, and this module is where the clocks are allowed.
 
 Pure host code; costs a dict write + one clock read per lifecycle
 transition (per REQUEST, not per token), which is noise next to a
@@ -24,12 +32,52 @@ single decode segment dispatch.
 
 from __future__ import annotations
 
+import re
 import time
 from typing import Dict, Optional
 
 from orion_tpu.utils.metrics import Counter, Histogram
 
-__all__ = ["RequestTelemetry"]
+__all__ = ["RequestTelemetry", "TokenBucket"]
+
+
+def _safe_label(name) -> str:
+    """Metric-column-safe tenant label (histogram keys become jsonl /
+    tensorboard column names)."""
+    return re.sub(r"[^0-9A-Za-z_]", "_", str(name))
+
+
+class TokenBucket:
+    """Token-bucket rate limiter for per-tenant admission (ISSUE 12).
+
+    ``rate`` tokens accrue per second up to ``burst``; ``try_acquire``
+    never blocks — it returns 0.0 on success or the seconds until the
+    requested tokens accrue (the ``EngineOverloaded.retry_after``
+    hint).  Rate 0 disables the limit."""
+
+    def __init__(self, rate: float, burst: float):
+        if rate < 0 or burst <= 0:
+            raise ValueError(
+                f"rate must be >= 0 and burst > 0, got {rate}/{burst}")
+        self.rate = float(rate)
+        self.burst = float(burst)
+        self._level = float(burst)
+        self._t = time.monotonic()
+
+    def try_acquire(self, n: float = 1.0) -> float:
+        """Take ``n`` tokens if available.  Returns 0.0 on success,
+        else the seconds until ``n`` tokens will have accrued (no
+        tokens are consumed on failure)."""
+        if self.rate <= 0:
+            return 0.0
+        now = time.monotonic()
+        self._level = min(self.burst,
+                          self._level + (now - self._t) * self.rate)
+        self._t = now
+        if self._level >= n:
+            self._level -= n
+            return 0.0
+        return (n - self._level) / self.rate
 
 
 class RequestTelemetry:
@@ -37,6 +85,7 @@ class RequestTelemetry:
 
     def __init__(self):
         self._marks: Dict[int, Dict[str, float]] = {}
+        self._tenant_of: Dict[int, str] = {}
         self.queue_wait_s = Histogram()
         self.ttft_s = Histogram()
         self.tok_per_s = Histogram()
@@ -45,25 +94,50 @@ class RequestTelemetry:
         self.spec_acceptance = Histogram()
         self.finished = Counter()
         self.preempted = Counter()
+        self.shed = Counter()
+        # tenant label -> metric suffix -> Histogram/Counter, created
+        # lazily at the first submit carrying that tenant tag.
+        self._tenant_hists: Dict[str, Dict[str, Histogram]] = {}
+        self._tenant_counts: Dict[str, Dict[str, Counter]] = {}
 
     def _instant(self, name: str, **attrs) -> None:
         from orion_tpu.obs import instant
 
         instant(name, **attrs)
 
+    # -- per-tenant stores -----------------------------------------------
+    def _tenant_hist(self, tenant: str, metric: str) -> Histogram:
+        return self._tenant_hists.setdefault(tenant, {}).setdefault(
+            metric, Histogram())
+
+    def _tenant_count(self, tenant: str, metric: str) -> Counter:
+        return self._tenant_counts.setdefault(tenant, {}).setdefault(
+            metric, Counter())
+
     # -- lifecycle marks -------------------------------------------------
     def mark(self, req_id: int, stage: str, **attrs) -> None:
         """Record a lifecycle transition.  Stages with derived
         latencies: ``admit`` records queue wait, ``first_token``
-        records TTFT (both relative to ``submit``)."""
+        records TTFT (both relative to ``submit``).  A ``submit`` mark
+        carrying ``tenant=`` routes this request's latencies into that
+        tenant's histograms as well."""
         t = time.monotonic()
         m = self._marks.setdefault(req_id, {})
         m[stage] = t
+        if stage == "submit" and "tenant" in attrs:
+            self._tenant_of[req_id] = _safe_label(attrs["tenant"])
         self._instant(f"req.{stage}", req=int(req_id), **attrs)
+        tenant = self._tenant_of.get(req_id)
         if stage == "admit" and "submit" in m:
-            self.queue_wait_s.record(t - m["submit"])
+            wait = t - m["submit"]
+            self.queue_wait_s.record(wait)
+            if tenant is not None:
+                self._tenant_hist(tenant, "queue_wait_s").record(wait)
         elif stage == "first_token" and "submit" in m:
-            self.ttft_s.record(t - m["submit"])
+            ttft = t - m["submit"]
+            self.ttft_s.record(ttft)
+            if tenant is not None:
+                self._tenant_hist(tenant, "ttft_s").record(ttft)
 
     def preempt(self, req_id: int) -> None:
         """Restart-by-recompute: the request goes back to waiting, so
@@ -81,16 +155,28 @@ class RequestTelemetry:
         t = time.monotonic()
         m = self._marks.pop(req_id, {})
         self.finished.add()
+        tenant = self._tenant_of.pop(req_id, None)
+        if tenant is not None:
+            self._tenant_count(tenant, "finished").add()
         ft = m.get("first_token")
         if ft is not None and n_tokens > 1:
             self.tok_per_s.record((n_tokens - 1) / max(t - ft, 1e-9))
         self._instant("req.finish", req=int(req_id),
                       tokens=int(n_tokens))
 
+    def record_shed(self, tenant=None) -> None:
+        """Count a load-shed (``EngineOverloaded``) admission refusal
+        — globally and, when tagged, per tenant."""
+        self.shed.add()
+        if tenant is not None:
+            self._tenant_count(_safe_label(tenant), "shed").add()
+        self._instant("req.shed", tenant=str(tenant))
+
     def drop(self, req_id: int) -> None:
         """Forget a request without counting a finish (caller-side
         cancellation paths)."""
         self._marks.pop(req_id, None)
+        self._tenant_of.pop(req_id, None)
 
     # -- gauges ----------------------------------------------------------
     def record_occupancy(self, fraction: float) -> None:
@@ -107,7 +193,11 @@ class RequestTelemetry:
 
     # -- readout ---------------------------------------------------------
     def histograms(self) -> Dict[str, Histogram]:
-        return {
+        """Global + tenant-labelled histograms.  The labelled keys
+        (``tenant_<name>_<metric>``) expand into ``_p50/_p95/_p99``
+        columns through ``MetricsWriter.write`` exactly like the
+        global ones — per-tenant SLOs need no writer plumbing."""
+        out = {
             "queue_wait_s": self.queue_wait_s,
             "ttft_s": self.ttft_s,
             "tok_per_s": self.tok_per_s,
@@ -115,6 +205,21 @@ class RequestTelemetry:
             "page_occupancy": self.page_occupancy,
             "spec_acceptance": self.spec_acceptance,
         }
+        for tenant, hists in self._tenant_hists.items():
+            for metric, hist in hists.items():
+                out[f"tenant_{tenant}_{metric}"] = hist
+        return out
+
+    def counters(self) -> Dict[str, Counter]:
+        out = {
+            "requests_finished": self.finished,
+            "requests_preempted": self.preempted,
+            "requests_shed": self.shed,
+        }
+        for tenant, counts in self._tenant_counts.items():
+            for metric, c in counts.items():
+                out[f"tenant_{tenant}_{metric}"] = c
+        return out
 
     def summary(self) -> Dict[str, float]:
         """Flat numeric p50/p95/p99/mean/count dict — the shape the
@@ -122,13 +227,14 @@ class RequestTelemetry:
         out: Dict[str, float] = {}
         for name, hist in self.histograms().items():
             out.update(hist.summary(name))
-        out["requests_finished"] = float(self.finished.value)
-        out["requests_preempted"] = float(self.preempted.value)
+        for name, c in self.counters().items():
+            out[name] = float(c.value)
         return out
 
     def reset(self, keep_marks: bool = True) -> None:
-        """Drop accumulated histograms/counters (bench window resets).
-        In-flight request marks survive by default so a request
+        """Drop accumulated histograms/counters INCLUDING all
+        per-tenant state (bench window resets).  In-flight request
+        marks (and their tenant tags) survive by default so a request
         straddling the reset still finishes with sane latencies."""
         self.queue_wait_s = Histogram()
         self.ttft_s = Histogram()
@@ -138,5 +244,9 @@ class RequestTelemetry:
         self.spec_acceptance = Histogram()
         self.finished = Counter()
         self.preempted = Counter()
+        self.shed = Counter()
+        self._tenant_hists = {}
+        self._tenant_counts = {}
         if not keep_marks:
             self._marks.clear()
+            self._tenant_of.clear()

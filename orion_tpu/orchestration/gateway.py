@@ -1,0 +1,638 @@
+"""Token-streaming, multi-tenant serving gateway (ISSUE 12 tentpole).
+
+The continuous engine became a standing service in PR 8 and learned
+token-level streaming + per-tenant QoS in this PR — but its only
+client lived in-process.  This module is the network front door: a
+:class:`ServingGateway` accepts remote clients over the hardened
+``ORTP`` framed channel (magic + version header, keepalive, recv
+deadlines — the exact transport the worker pool runs on) and fans
+completion tokens out AS THE ENGINE HARVESTS THEM, so a remote
+client's observed TTFT is first-token time, not full-completion time.
+
+Second frame family on the channel (protocol v5):
+
+- ``FRAME_SUBMIT``  client → gateway: prompt ids + budget / priority /
+  deadline under the client's connection-bound tenant;
+- ``FRAME_STREAM``  gateway → client: incremental token chunks
+  (``done`` marks the final chunk, which carries the full completion
+  incl. logprobs), stream restarts after preemption, and typed error
+  payloads — an :class:`~orion_tpu.rollout.continuous.EngineOverloaded`
+  shed is forwarded with its queue depth + retry-after hint and
+  re-raised as the same typed error client-side;
+- ``FRAME_CANCEL``  client → gateway: abort an in-flight request.
+
+HELLO / GOODBYE are shared with the pool protocol: a client's HELLO
+names its tenant (the QoS class every submit on that connection runs
+under), and either side leaves with GOODBYE.
+
+Threading: the engine is single-owner.  Per-client receive threads
+only parse frames and enqueue ops; ONE pump (``step()`` /
+``serve_forever``) owns the engine — it drains ops, steps the engine,
+and sends STREAM frames from the engine's token callbacks.  All
+shared gateway state is guarded by ``self._lock`` (lock-discipline
+rule), and every thread registers with the Watchdog like the worker
+pool's.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import pickle
+import queue
+import threading
+import time
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from orion_tpu import obs
+from orion_tpu.orchestration.remote import (FRAME_GOODBYE, FRAME_HELLO,
+                                            PROTOCOL_VERSION,
+                                            ProtocolError, PyTreeChannel,
+                                            listen_socket)
+from orion_tpu.resilience import Watchdog
+from orion_tpu.rollout.continuous import (CompletedRequest,
+                                          EngineOverloaded, StreamChunk)
+
+_LOG = logging.getLogger(__name__)
+
+# The serving-gateway frame family (PROTOCOL_VERSION 5).  Values are
+# disjoint from the pool family in remote.py (0-6); kept in a separate
+# range so a frame number in a log unambiguously names its family.
+FRAME_SUBMIT = 16   # client → gateway: enqueue a generation request
+FRAME_STREAM = 17   # gateway → client: token chunk / final / error
+FRAME_CANCEL = 18   # client → gateway: abort an in-flight request
+
+_FRAME_NAMES = {
+    FRAME_HELLO: "HELLO", FRAME_GOODBYE: "GOODBYE",
+    FRAME_SUBMIT: "SUBMIT", FRAME_STREAM: "STREAM",
+    FRAME_CANCEL: "CANCEL",
+}
+
+
+@dataclasses.dataclass
+class StreamEvent:
+    """Client-side view of one STREAM frame.
+
+    ``tokens`` are the new completion tokens since the previous event
+    for this request; ``restarted`` voids everything delivered before
+    (server-side preemption restarted the stream).  The final event
+    has ``done=True`` and either ``completed`` (success — full tokens
+    + logprobs, identical to what in-process ``generate()`` returns)
+    or ``error`` (an :class:`EngineOverloaded` for sheds, a string
+    reason otherwise, e.g. ``"cancelled"``)."""
+
+    req_id: int
+    tokens: np.ndarray
+    done: bool = False
+    restarted: bool = False
+    error: Optional[Any] = None
+    completed: Optional[CompletedRequest] = None
+
+
+def parse_tenant_spec(spec: str) -> Dict[str, dict]:
+    """Parse a compact tenant-QoS spec string into configure_tenant
+    kwargs: ``"paid:weight=4,rate=100;free:weight=1,max_queued=8"``
+    → ``{"paid": {"weight": 4, "rate_limit": 100.0}, "free": {...}}``.
+    Used by ``launch.py --serve`` so QoS envelopes need no config-file
+    plumbing."""
+    out: Dict[str, dict] = {}
+    for part in filter(None, (p.strip() for p in spec.split(";"))):
+        name, sep, kvs = part.partition(":")
+        if not sep or not name.strip():
+            # A typo'd part ("paid=4,rate=100", missing colon) must
+            # fail loudly — silently registering a tenant literally
+            # named "paid=4,rate=100" with default QoS leaves the real
+            # tenant unlimited.
+            raise ValueError(
+                f"tenant spec part {part!r} must look like "
+                "'name:key=value,...' (missing ':')")
+        kw: dict = {}
+        for kv in filter(None, (s.strip() for s in kvs.split(","))):
+            key, _, val = kv.partition("=")
+            key = {"rate": "rate_limit"}.get(key.strip(), key.strip())
+            if key in ("weight", "max_queued", "max_running"):
+                kw[key] = int(val)
+            elif key in ("rate_limit", "burst"):
+                kw[key] = float(val)
+            else:
+                raise ValueError(f"unknown tenant-spec key {key!r} in "
+                                 f"{part!r}")
+        out[name.strip()] = kw
+    return out
+
+
+class _Client:
+    """Gateway-side record of one connected client."""
+
+    def __init__(self, cid: int, name: str, tenant: str,
+                 chan: PyTreeChannel, hb):
+        self.cid = cid
+        self.name = name
+        self.tenant = tenant
+        self.chan = chan
+        self.hb = hb
+        self.alive = True
+        self.reqs: Dict[int, int] = {}  # client req id -> engine rid
+
+
+class ServingGateway:
+    """Network front door for one :class:`ContinuousBatchingEngine`.
+
+    The engine must already have weights loaded and an RNG seeded
+    (``load_weights`` + ``reset_rng``).  ``tenants`` maps tenant name
+    → ``configure_tenant`` kwargs (weight / rate_limit / burst /
+    max_queued); unknown tenants connect with default QoS.  Drive the
+    serve loop either with :meth:`serve_forever` (blocking; pass a
+    ``stop`` event) or :meth:`start`/:meth:`close` (background pump
+    thread — the in-process test harness)."""
+
+    def __init__(self, engine, port: int = 0, host: str = "localhost",
+                 tenants: Optional[Dict[str, dict]] = None,
+                 recv_deadline: float = 0.0, tracer=None,
+                 idle_wait: float = 0.002):
+        self.engine = engine
+        self.host = host
+        self._tracer = tracer
+        self._idle_wait = idle_wait
+        self.recv_deadline = recv_deadline
+        for name, kw in (tenants or {}).items():
+            engine.configure_tenant(name, **kw)
+        self.watchdog = Watchdog()
+        self._lock = threading.Lock()
+        self._clients: Dict[int, _Client] = {}
+        self._next_cid = 0
+        self._next_rid = 0
+        self._live: Dict[int, tuple] = {}   # engine rid -> (client, cid req)
+        self._ops: queue.Queue = queue.Queue()
+        self._stop = threading.Event()
+        self._pump_thread: Optional[threading.Thread] = None
+        self.stats = {"submits": 0, "sheds": 0, "cancels": 0,
+                      "clients_joined": 0, "clients_left": 0}
+
+        self._srv = listen_socket(port, host=host)
+        self.port = self._srv.getsockname()[1]
+        accept_hb = self.watchdog.register("gw-accept", timeout=0.0)
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, args=(accept_hb,),
+            name="gw-accept", daemon=True)
+        self._accept_thread.start()
+
+    # -- membership ------------------------------------------------------
+    def _accept_loop(self, hb) -> None:
+        import socket as _socket
+
+        while not self._stop.is_set():
+            hb.beat()
+            try:
+                conn, addr = self._srv.accept()
+            except _socket.timeout:
+                continue
+            except OSError as e:
+                if self._stop.is_set():
+                    return
+                _LOG.warning("gateway accept error (transient): %r", e)
+                time.sleep(0.1)
+                continue
+            # Admission runs in a short-lived per-connection thread,
+            # exactly like the worker pool's: _admit blocks on the
+            # peer's HELLO (deadlined, floor 10 s), and ONE silent
+            # stray parked in that handshake must not serialize every
+            # healthy client behind it in the accept backlog.
+            threading.Thread(  # orion: ignore[unsupervised-thread] handshake thread is strictly deadlined (recv deadline >= 10s), not a long-lived worker
+                target=self._admit_conn, args=(conn, addr),
+                name=f"gw-admit-{addr[1] if len(addr) > 1 else addr}",
+                daemon=True).start()
+
+    def _admit_conn(self, conn, addr) -> None:
+        try:
+            self._admit(conn)
+        except (ProtocolError, ConnectionError, TimeoutError,
+                pickle.UnpicklingError, OSError) as e:
+            _LOG.warning("gateway refused a peer at %s: %s", addr, e)
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _admit(self, conn) -> None:
+        chan = PyTreeChannel(conn, recv_deadline=max(
+            self.recv_deadline, 10.0) if self.recv_deadline else 10.0,
+            tracer=self._tracer)
+        kind, hello = chan.recv_frame()
+        if kind != FRAME_HELLO:
+            raise ProtocolError(
+                f"expected HELLO, got {_FRAME_NAMES.get(kind, kind)}")
+        chan.set_recv_deadline(self.recv_deadline)
+        tenant = str(hello.get("tenant", "default"))
+        with self._lock:
+            cid = self._next_cid
+            self._next_cid += 1
+        name = str(hello.get("name", f"client-{cid}"))
+        chan.send_frame(FRAME_HELLO,
+                        {"cid": cid, "protocol": PROTOCOL_VERSION,
+                         "tenant": tenant})
+        hb = self.watchdog.register(f"gw-client-{cid}", timeout=0.0)
+        client = _Client(cid, name, tenant, chan, hb)
+        thread = threading.Thread(
+            target=self._recv_loop, args=(client,),
+            name=f"gw-recv-{cid}", daemon=True)
+        with self._lock:
+            admitted = not self._stop.is_set()
+            if admitted:
+                self._clients[cid] = client
+                self.stats["clients_joined"] += 1
+        if not admitted:
+            # close() raced the (threaded) handshake: release the peer
+            # instead of registering a client nobody will ever drop.
+            self.watchdog.unregister(hb.name)
+            try:
+                chan.send_frame(FRAME_GOODBYE, {"reason": "shutdown"})
+            except (ConnectionError, TimeoutError, OSError):
+                pass
+            chan.close()
+            return
+        thread.start()
+        if obs.get_tracer().enabled:
+            obs.instant("gw.client-join", cid=cid, tenant=tenant)
+        _LOG.info("gateway admitted %s (tenant=%s) as cid=%d",
+                  name, tenant, cid)
+
+    def _recv_loop(self, client: _Client) -> None:
+        """One thread per client: parse frames, enqueue ops.  The pump
+        thread owns the engine — nothing here touches it."""
+        try:
+            while not self._stop.is_set():
+                client.hb.beat()
+                kind, payload = client.chan.recv_frame()
+                if kind == FRAME_SUBMIT:
+                    self._ops.put(("submit", client, payload))
+                elif kind == FRAME_CANCEL:
+                    self._ops.put(("cancel", client, payload))
+                elif kind == FRAME_GOODBYE:
+                    self._ops.put(("leave", client, None))
+                    return
+                else:
+                    raise ProtocolError(
+                        f"unexpected {_FRAME_NAMES.get(kind, kind)} "
+                        "frame from gateway client")
+        except (ConnectionError, TimeoutError, OSError, EOFError,
+                pickle.UnpicklingError) as e:
+            # Dropped client: the pump cancels its in-flight work.
+            self._ops.put(("leave", client, repr(e)))
+
+    # -- pump (single engine owner) --------------------------------------
+    def _send_stream(self, client: _Client, payload: dict) -> None:
+        if not client.alive:
+            return
+        try:
+            client.chan.send_frame(FRAME_STREAM, payload)
+        except (ConnectionError, TimeoutError, OSError) as e:
+            _LOG.warning("gateway send to cid=%d failed: %r",
+                         client.cid, e)
+            # May be running INSIDE engine.step() (token callback):
+            # _drop_client defers the engine-side aborts to the next
+            # pump iteration, so the engine is never mutated
+            # re-entrantly mid-wave.
+            self._drop_client(client)
+
+    def _on_chunk(self, client: _Client, creq: int,
+                  chunk: StreamChunk) -> None:
+        """Engine token callback (runs inside engine.step() on the
+        pump thread): fan the chunk out as a STREAM frame."""
+        payload: dict = {"req": creq, "tokens": chunk.tokens,
+                         "done": chunk.done,
+                         "restarted": chunk.restarted}
+        if chunk.done:
+            comp = chunk.completed
+            payload["final_tokens"] = comp.tokens
+            payload["logprobs"] = comp.logprobs
+            payload["policy_logprobs"] = comp.policy_logprobs
+            with self._lock:
+                self._live.pop(client.reqs.pop(creq, None), None)
+        self._send_stream(client, payload)
+
+    def _apply_submit(self, client: _Client, p: dict) -> None:
+        creq = int(p["req"])
+        with self._lock:
+            duplicate = creq in client.reqs
+        if duplicate:
+            self._send_stream(client, {
+                "req": creq, "done": True, "tokens": np.empty(0, np.int32),
+                "error": "bad-request",
+                "message": f"request id {creq} already in flight"})
+            return
+        with self._lock:
+            rid = self._next_rid
+            self._next_rid += 1
+        try:
+            self.engine.submit(
+                rid, np.asarray(p["ids"], np.int32),
+                budget=p.get("budget"),
+                priority=int(p.get("priority", 0)),
+                deadline=p.get("deadline"),
+                tenant=client.tenant, stream=True,
+                on_tokens=lambda chunk, c=client, q=creq:
+                    self._on_chunk(c, q, chunk))
+            with self._lock:
+                client.reqs[creq] = rid
+                self._live[rid] = (client, creq)
+                self.stats["submits"] += 1
+        except EngineOverloaded as e:
+            # Typed backpressure crosses the wire: depth + retry hint
+            # ride the error payload and the client re-raises the same
+            # EngineOverloaded type.
+            with self._lock:
+                self.stats["sheds"] += 1
+            self._send_stream(client, {
+                "req": creq, "done": True,
+                "tokens": np.empty(0, np.int32), "error": "overloaded",
+                "message": str(e), "queue_depth": e.queue_depth,
+                "retry_after": e.retry_after, "tenant": e.tenant})
+        except ValueError as e:
+            self._send_stream(client, {
+                "req": creq, "done": True,
+                "tokens": np.empty(0, np.int32),
+                "error": "bad-request", "message": str(e)})
+
+    def _apply_cancel(self, client: _Client, p: dict) -> None:
+        creq = int(p["req"])
+        with self._lock:
+            rid = client.reqs.get(creq)
+        if rid is None:
+            return  # finished (or never existed): cancel is a no-op
+        try:
+            self.engine.cancel(rid)
+        except KeyError:
+            pass
+        with self._lock:
+            self._live.pop(rid, None)
+            client.reqs.pop(creq, None)
+            self.stats["cancels"] += 1
+        self._send_stream(client, {
+            "req": creq, "done": True, "tokens": np.empty(0, np.int32),
+            "error": "cancelled", "message": "cancelled by client"})
+
+    def _drop_client(self, client: _Client, goodbye: bool = False) -> None:
+        with self._lock:
+            if not client.alive:
+                return
+            client.alive = False
+            rids = list(client.reqs.values())
+            client.reqs.clear()
+            for rid in rids:
+                self._live.pop(rid, None)
+            self.stats["clients_left"] += 1
+        self.watchdog.unregister(client.hb.name)
+        if rids:
+            # Deferred to the next pump iteration: this method can run
+            # inside engine.step() (a send failing from a token
+            # callback), where an inline engine.cancel would mutate
+            # engine state mid-wave.
+            self._ops.put(("reap", None, rids))
+        if goodbye:
+            try:
+                client.chan.send_frame(FRAME_GOODBYE,
+                                       {"reason": "shutdown"})
+            except (ConnectionError, TimeoutError, OSError):
+                pass
+        try:
+            client.chan.close()
+        except OSError:
+            pass
+        if obs.get_tracer().enabled:
+            obs.instant("gw.client-leave", cid=client.cid)
+
+    def step(self) -> int:
+        """One pump iteration: apply queued client ops, run one engine
+        wave, fan out the resulting stream chunks (the engine fires
+        the callbacks inside ``step()``).  Returns the number of
+        requests still in flight."""
+        while True:
+            try:
+                op, client, payload = self._ops.get_nowait()
+            except queue.Empty:
+                break
+            if op == "submit":
+                self._apply_submit(client, payload)
+            elif op == "cancel":
+                self._apply_cancel(client, payload)
+            elif op == "leave":
+                self._drop_client(client)
+            elif op == "reap":
+                # Engine-side aborts for a client dropped mid-wave —
+                # applied here, OUTSIDE any engine.step().
+                for rid in payload:
+                    try:
+                        self.engine.cancel(rid)
+                    except (KeyError, ValueError):
+                        pass
+            else:  # pragma: no cover - internal op enum
+                raise RuntimeError(f"unknown gateway op {op!r}")
+        if self.engine.pending:
+            self.engine.step()
+        return int(self.engine.pending)
+
+    def serve_forever(self, stop: Optional[threading.Event] = None,
+                      preemption=None, hb=None) -> None:
+        """Blocking pump loop until ``stop`` is set (or ``preemption``
+        — a resilience.preemption handler — requests exit)."""
+        if hb is None:
+            hb = self.watchdog.register("gw-pump", timeout=0.0)
+        try:
+            while not self._stop.is_set():
+                hb.beat()
+                if stop is not None and stop.is_set():
+                    break
+                if preemption is not None and preemption.requested:
+                    break
+                if self.step() == 0 and self._ops.empty():
+                    # idle: nothing in flight, wait briefly for work
+                    time.sleep(self._idle_wait)
+        finally:
+            self.watchdog.unregister(hb.name)
+
+    def start(self) -> None:
+        """Run :meth:`serve_forever` on a background pump thread (the
+        in-process harness tests and benches drive)."""
+        if self._pump_thread is not None:
+            raise RuntimeError("gateway pump already started")
+        pump_hb = self.watchdog.register("gw-pump", timeout=0.0)
+        self._pump_thread = threading.Thread(
+            target=self.serve_forever, kwargs={"hb": pump_hb},
+            name="gw-pump", daemon=True)
+        self._pump_thread.start()
+
+    def close(self) -> None:
+        """Stop the pump + accept loops, GOODBYE every client, abort
+        their in-flight requests, close every channel.  The engine
+        (caller-owned) is left intact — and DRAINED of this gateway's
+        work: once the pump is joined this thread owns the engine, so
+        the reap ops _drop_client enqueues are applied here instead of
+        rotting in the queue (a caller re-fronting the engine must not
+        inherit cancelled clients' decoding)."""
+        self._stop.set()
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+        if self._pump_thread is not None:
+            self._pump_thread.join(timeout=5.0)
+            self._pump_thread = None
+        with self._lock:
+            clients = list(self._clients.values())
+        for c in clients:
+            self._drop_client(c, goodbye=True)
+        # Drain leftover ops (reaps from the drops above, plus
+        # anything the pump never got to).  Submits are NOT applied —
+        # their clients are gone.
+        while True:
+            try:
+                op, _client, payload = self._ops.get_nowait()
+            except queue.Empty:
+                break
+            if op == "reap":
+                for rid in payload:
+                    try:
+                        self.engine.cancel(rid)
+                    except (KeyError, ValueError):
+                        pass
+        if self._accept_thread.is_alive():
+            self._accept_thread.join(timeout=2.0)
+
+
+class GatewayClient:
+    """Remote-client side of the gateway protocol.
+
+    Connects, HELLOs with its tenant, then submits requests and reads
+    :class:`StreamEvent` increments as the gateway fans them out.
+    ``next_event`` blocks up to ``timeout``; an
+    :class:`EngineOverloaded` shed arrives as an event whose ``error``
+    IS that typed exception (depth + retry-after preserved), so a
+    remote client backs off exactly like an in-process caller."""
+
+    def __init__(self, port: int, host: str = "localhost",
+                 tenant: str = "default", name: Optional[str] = None,
+                 connect_timeout: float = 30.0,
+                 recv_deadline: float = 0.0, tracer=None):
+        import os as _os
+
+        self.tenant = str(tenant)
+        self.name = name or f"gw-client-{_os.getpid()}"
+        self.closed = threading.Event()
+        self._events: queue.Queue = queue.Queue()
+        self._next_req = 0
+        self.watchdog = Watchdog()
+        self.chan = PyTreeChannel.connect(
+            port, host=host, timeout=connect_timeout,
+            recv_deadline=recv_deadline, tracer=tracer)
+        self.chan.send_frame(FRAME_HELLO,
+                             {"name": self.name, "tenant": self.tenant,
+                              "protocol": PROTOCOL_VERSION})
+        kind, ack = self.chan.recv_frame()
+        if kind == FRAME_GOODBYE:
+            self.chan.close()
+            raise ConnectionError(
+                f"gateway refused {self.name}: "
+                f"{ack.get('reason', 'no reason given')}")
+        if kind != FRAME_HELLO:
+            self.chan.close()
+            raise ProtocolError(
+                f"expected HELLO ack, got {_FRAME_NAMES.get(kind, kind)}")
+        self.cid = int(ack["cid"])
+        rx_hb = self.watchdog.register(f"gw-client-rx-{self.cid}",
+                                       timeout=0.0)
+        self._rx_thread = threading.Thread(
+            target=self._recv_loop, args=(rx_hb,),
+            name="gw-client-recv", daemon=True)
+        self._rx_thread.start()
+
+    def _recv_loop(self, hb) -> None:
+        try:
+            while not self.closed.is_set():
+                hb.beat()
+                kind, p = self.chan.recv_frame()
+                if kind == FRAME_STREAM:
+                    self._events.put(self._to_event(p))
+                elif kind == FRAME_GOODBYE:
+                    self.closed.set()
+                    return
+                else:
+                    raise ProtocolError(
+                        f"unexpected {_FRAME_NAMES.get(kind, kind)} "
+                        "frame from gateway")
+        except (ConnectionError, TimeoutError, OSError, EOFError,
+                pickle.UnpicklingError):
+            self.closed.set()
+
+    @staticmethod
+    def _to_event(p: dict) -> StreamEvent:
+        error: Any = p.get("error")
+        completed = None
+        if error == "overloaded":
+            # Re-raise-able typed backpressure: same exception type,
+            # same depth/retry fields as the in-process path.
+            error = EngineOverloaded(
+                p.get("message", "engine overloaded"),
+                queue_depth=p.get("queue_depth", 0),
+                retry_after=p.get("retry_after", 0.0),
+                tenant=p.get("tenant"))
+        elif p.get("done") and error is None:
+            completed = CompletedRequest(
+                req_id=int(p["req"]),
+                tokens=np.asarray(p["final_tokens"], np.int32),
+                logprobs=np.asarray(p["logprobs"], np.float32),
+                policy_logprobs=np.asarray(p["policy_logprobs"],
+                                           np.float32))
+        return StreamEvent(
+            req_id=int(p["req"]),
+            tokens=np.asarray(p.get("tokens", ()), np.int32),
+            done=bool(p.get("done", False)),
+            restarted=bool(p.get("restarted", False)),
+            error=error, completed=completed)
+
+    # -- request surface -------------------------------------------------
+    def submit(self, ids, budget: Optional[int] = None,
+               priority: int = 0, deadline: Optional[int] = None,
+               req_id: Optional[int] = None) -> int:
+        """Fire-and-stream: returns the request id whose StreamEvents
+        will arrive via :meth:`next_event`."""
+        if self.closed.is_set():
+            raise ConnectionError("gateway connection is closed")
+        if req_id is None:
+            req_id = self._next_req
+        self._next_req = max(self._next_req, int(req_id)) + 1
+        self.chan.send_frame(FRAME_SUBMIT, {
+            "req": int(req_id), "ids": np.asarray(ids, np.int32),
+            "budget": budget, "priority": int(priority),
+            "deadline": deadline})
+        return int(req_id)
+
+    def cancel(self, req_id: int) -> None:
+        self.chan.send_frame(FRAME_CANCEL, {"req": int(req_id)})
+
+    def next_event(self, timeout: Optional[float] = None
+                   ) -> Optional[StreamEvent]:
+        """The next StreamEvent from any in-flight request, or None on
+        timeout.  Raises ConnectionError once the channel is closed
+        AND the buffered events are drained."""
+        try:
+            return self._events.get(timeout=timeout)
+        except queue.Empty:
+            if self.closed.is_set():
+                raise ConnectionError(
+                    "gateway connection closed") from None
+            return None
+
+    def close(self) -> None:
+        if not self.closed.is_set():
+            try:
+                self.chan.send_frame(FRAME_GOODBYE, {"reason": "done"})
+            except (ConnectionError, TimeoutError, OSError):
+                pass
+        self.closed.set()
+        try:
+            self.chan.close()
+        except OSError:
+            pass

@@ -91,7 +91,12 @@ MAGIC = b"ORTP"
 #: v4: the header grew trace/span ids (distributed tracing — one
 #: trace id stitches learner + every worker into a single Perfetto
 #: timeline); a v3 peer is rejected cleanly by the version check.
-PROTOCOL_VERSION = 4
+#: v5: the serving-gateway frame family (FRAME_SUBMIT / FRAME_STREAM /
+#: FRAME_CANCEL, defined in orchestration/gateway.py) joined the
+#: channel — the header itself is unchanged, but a v4 peer predates
+#: those kinds and must be rejected at the handshake, not when the
+#: first unknown frame arrives mid-stream.
+PROTOCOL_VERSION = 5
 
 #: magic(4) + version(u16) + kind(u8) + trace id(u64) + originating
 #: span id(u64) + payload length(u64).  The trace/span ids are 0 when
@@ -109,6 +114,7 @@ _HEADER = struct.Struct(">4sHBQQQ")
 _HEADER_HISTORY = {
     3: ">4sHBQ",     # PR 6: magic + version + kind + length
     4: ">4sHBQQQ",   # PR 9: + trace id + span id (distributed tracing)
+    5: ">4sHBQQQ",   # PR 12: same header; gateway frame family added
 }
 
 # Frame kinds multiplexed on one channel.
@@ -170,6 +176,22 @@ def _harden_socket(sock: socket.socket) -> None:
                             struct.pack("ll", 300, 0))
         except OSError:  # pragma: no cover - platform-dependent
             pass
+
+
+def listen_socket(port: int, host: str = "localhost", backlog: int = 16,
+                  accept_timeout: float = 0.5) -> socket.socket:
+    """A configured listening TCP socket for a frame-channel accept
+    loop.  ALL raw socket creation stays in this module (the
+    ``raw-socket`` analysis rule): WorkerPool and the serving gateway
+    both accept peers through sockets built here, and every accepted
+    connection is immediately wrapped in :class:`PyTreeChannel` —
+    nothing outside this file speaks unframed bytes."""
+    srv = socket.socket()
+    srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    srv.bind((host, port))
+    srv.listen(backlog)
+    srv.settimeout(accept_timeout)
+    return srv
 
 
 class PyTreeChannel:

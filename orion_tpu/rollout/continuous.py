@@ -55,12 +55,37 @@ draft/verify ported onto the paged per-slot machinery:
   workloads degrade to ~zero overhead), and cold slots riding a hot
   wave keep drafting for free — which is also how they re-probe.
 
+PR 12 made the service MULTI-TENANT and STREAMING:
+
+- Token streaming: ``submit(..., stream=True)`` delivers completion
+  tokens INCREMENTALLY as waves harvest them — via ``poll(req_id)``
+  (pull) or an ``on_tokens`` callback fired inside ``step()`` (push).
+  Streaming changes only what the host FETCHES per wave (the token
+  buffer rides the existing lagged flags snapshot), never what the
+  device computes, so the streamed token sequence is bit-exact
+  against ``generate()`` for the same seed.  A preempted streaming
+  request restarts its stream (``StreamChunk.restarted``: discard
+  earlier chunks — restart-by-recompute re-derives them).
+- Per-tenant QoS: ``submit(..., tenant=...)`` tags requests with an
+  admission class.  ``configure_tenant`` registers a weighted-fair
+  share (scheduler-level WFQ layered UNDER the fifo/priority/EDF
+  policy), a token-bucket rate limit, and a per-tenant queue cap;
+  ``cfg.max_queued_requests`` adds a global waiting watermark.  A
+  refused submit raises the typed :class:`EngineOverloaded` carrying
+  queue depth + a retry-after hint (load shedding fails fast instead
+  of queueing without bound).  Per-tenant TTFT/queue-wait percentiles
+  ride ``server_stats()`` as ``tenant_<name>_*`` keys.
+- ``cancel(req_id)`` aborts an in-flight request (waiting: dequeued;
+  decoding: pages freed via the preemption machinery; mid-chunked-
+  prefill: deferred one wave to the activation boundary).
+
 Flow per wave (one ``step()``):
-  admit -> chunk-prefill admitted/partial prompts (final chunks sample
-  their first token) -> extend in-flight reservations (preempting if
-  dry) -> decode segment of K tokens OR speculative verify segment
-  (jitted) -> harvest finished slots (one wave lagged), free their
-  pages, return completions.
+  apply deferred cancels -> admit -> chunk-prefill admitted/partial
+  prompts (final chunks sample their first token) -> extend in-flight
+  reservations (preempting if dry) -> decode segment of K tokens OR
+  speculative verify segment (jitted) -> harvest finished slots (one
+  wave lagged), free their pages, emit stream chunks, return
+  completions.
 """
 
 from __future__ import annotations
@@ -97,6 +122,42 @@ class CompletedRequest:
     tokens: np.ndarray          # [n] completion token ids
     logprobs: np.ndarray        # [n] sampling-dist logprobs (f32)
     policy_logprobs: np.ndarray  # [n] raw (untempered) policy logprobs
+
+
+@dataclasses.dataclass
+class StreamChunk:
+    """One increment of a streaming request's completion (PR 12).
+
+    ``tokens`` holds the completion tokens emitted since the previous
+    chunk.  ``restarted`` means the request was preempted (restart-by-
+    recompute): every previously delivered chunk is void and this
+    chunk restarts the stream from completion position 0.  The final
+    chunk has ``done=True`` and carries the full
+    :class:`CompletedRequest` (tokens + logprobs), which is bit-exact
+    against what ``generate()`` returns for the same seed."""
+
+    req_id: int
+    tokens: np.ndarray
+    done: bool = False
+    restarted: bool = False
+    completed: Optional[CompletedRequest] = None
+
+
+class EngineOverloaded(RuntimeError):
+    """Typed backpressure (PR 12): admission refused by a QoS gate —
+    the global waiting watermark (``cfg.max_queued_requests``), a
+    tenant's queue cap, or a tenant's rate limit.  Carries the
+    observed queue depth and a retry-after hint so clients back off
+    with information instead of guessing; the serving gateway
+    forwards both to remote clients."""
+
+    def __init__(self, reason: str, queue_depth: int = 0,
+                 retry_after: float = 0.0,
+                 tenant: Optional[str] = None):
+        super().__init__(reason)
+        self.queue_depth = int(queue_depth)
+        self.retry_after = float(retry_after)
+        self.tenant = tenant
 
 
 class ContinuousBatchingEngine:
@@ -267,6 +328,19 @@ class ContinuousBatchingEngine:
         self._rng = None
         self.preemptions = 0         # recompute-restarts (metrics)
         self.prefix_cached_pages = 0  # prompt pages served from cache
+        # -- multi-tenant QoS + streaming (PR 12) ----------------------
+        # Tenant names map to dense scheduler ids in first-seen order;
+        # per-tenant QoS envelopes (weight / rate bucket / queue cap)
+        # are registered via configure_tenant and default to
+        # weight-1 / unlimited for unseen tenants.
+        self._tenant_ids: dict = {}      # name -> scheduler tenant id
+        self._tenant_qos: dict = {}      # name -> qos dict
+        self._tenant_queued: dict = {}   # name -> waiting member count
+        self._req_tenant: dict = {}      # member id -> tenant name
+        self._streams: dict = {}         # member id -> stream state
+        self._cancels: set = set()       # deferred (mid-prefill) aborts
+        self.shed_requests = 0           # EngineOverloaded refusals
+        self.cancelled_requests = 0
         # -- adaptive-k host state (speculative v2) --------------------
         # Two signals drive the per-wave verify decision:
         # (1) DRAFTABILITY — each segment program reports, per slot,
@@ -1045,18 +1119,78 @@ class ContinuousBatchingEngine:
         does this per call; standing-service users do it once."""
         self._rng = rng
 
+    def configure_tenant(self, tenant, weight: int = 1,
+                         rate_limit: float = 0.0,
+                         burst: Optional[float] = None,
+                         max_queued: int = 0,
+                         max_running: int = 0) -> None:
+        """Register (or update) a tenant's QoS envelope (PR 12):
+
+        - ``weight`` — weighted-fair admission share (scheduler WFQ:
+          under contention a weight-4 tenant is admitted ~4x the
+          tokens of a weight-1 tenant);
+        - ``rate_limit`` — submits per second (token bucket of depth
+          ``burst``, default max(rate, 1)); 0 = unlimited;
+        - ``max_queued`` — per-tenant cap on WAITING requests; 0 =
+          unlimited;
+        - ``max_running`` — per-tenant concurrency cap (engine slots
+          its admitted requests may occupy at once) — the reserved-
+          capacity lever: a best-effort flood capped at 2 of 8 slots
+          can never occupy the paying tenant's headroom between its
+          arrivals; 0 = unlimited.
+
+        Exceeding the rate limit or a queue cap sheds the submit with
+        :class:`EngineOverloaded`.  Unregistered tenants get weight 1
+        and no limits."""
+        from orion_tpu.obs import TokenBucket
+
+        name = str(tenant)
+        if int(weight) < 1:
+            raise ValueError(f"tenant weight must be >= 1, got {weight}")
+        tid = self._tenant_ids.setdefault(name, len(self._tenant_ids))
+        self.sched.set_tenant(tid, int(weight), int(max_running))
+        bucket = None
+        if rate_limit > 0:
+            bucket = TokenBucket(rate_limit,
+                                 burst if burst is not None
+                                 else max(float(rate_limit), 1.0))
+        self._tenant_qos[name] = {"weight": int(weight), "bucket": bucket,
+                                  "max_queued": int(max_queued)}
+
+    def _retry_after_hint(self) -> float:
+        """Backpressure hint: the recent mean queue wait approximates
+        how long the backlog takes to drain one admission's worth."""
+        qw = self.telemetry.queue_wait_s
+        return max(0.05, float(qw.mean)) if qw.count else 0.25
+
+    def _shed(self, reason: str, depth: int, retry_after: float,
+              tenant: str) -> None:
+        self.shed_requests += 1
+        self.telemetry.record_shed(tenant)
+        raise EngineOverloaded(reason, queue_depth=depth,
+                               retry_after=retry_after, tenant=tenant)
+
     def submit(self, req_id: int, ids, budget: Optional[int] = None,
                k: int = 1, priority: int = 0,
-               deadline: Optional[int] = None) -> None:
+               deadline: Optional[int] = None, tenant="default",
+               stream: bool = False, on_tokens=None) -> None:
         """Enqueue a request (or a k-clone sampling group with ids
         req_id .. req_id+k-1).  budget ≤ cfg.max_new_tokens caps the
         completion; priority/deadline feed the scheduler's admission
-        policy (cfg.admission_policy).  Completions come back from
-        later ``step()`` calls in finish order."""
+        policy (cfg.admission_policy); ``tenant`` names the QoS class
+        (weighted-fair admission + the configure_tenant limits).
+        ``stream=True`` delivers completion tokens incrementally via
+        ``poll(req_id)``, or pushes them through ``on_tokens(chunk)``
+        from inside ``step()`` when a callback is given.  Completions
+        come back from later ``step()`` calls in finish order either
+        way.  Raises :class:`EngineOverloaded` when a QoS gate refuses
+        admission (nothing is enqueued — the caller may retry after
+        ``retry_after``)."""
         cfg = self.cfg
         ids = np.asarray(ids, np.int32)
         budget = int(cfg.max_new_tokens if budget is None else budget)
         k = int(k)
+        name = str(tenant)
         if len(ids) < 1 or len(ids) > cfg.max_prompt_len:
             raise ValueError(
                 f"prompt {req_id}: length {len(ids)} outside "
@@ -1073,32 +1207,81 @@ class ContinuousBatchingEngine:
             if req_id + j in self._reqinfo:
                 raise ValueError(f"request id {req_id + j} already "
                                  "in flight")
+        # QoS gates AFTER validation, BEFORE any state mutation: a shed
+        # request leaves zero residue (retry-safe), a malformed one
+        # still gets its ValueError.  Order: global watermark, tenant
+        # queue cap, then the rate bucket (a queue-refused submit must
+        # not burn rate tokens).
+        total_waiting = sum(self._tenant_queued.values())
+        if cfg.max_queued_requests and \
+                total_waiting + k > cfg.max_queued_requests:
+            self._shed(
+                f"engine overloaded: {total_waiting} requests waiting "
+                f"(max_queued_requests={cfg.max_queued_requests})",
+                total_waiting, self._retry_after_hint(), name)
+        qos = self._tenant_qos.get(name)
+        if qos is not None:
+            tq = self._tenant_queued.get(name, 0)
+            if qos["max_queued"] and tq + k > qos["max_queued"]:
+                self._shed(
+                    f"tenant {name!r} overloaded: {tq} requests "
+                    f"waiting (max_queued={qos['max_queued']})",
+                    tq, self._retry_after_hint(), name)
+            if qos["bucket"] is not None:
+                wait = qos["bucket"].try_acquire(k)
+                if wait > 0:
+                    self._shed(
+                        f"tenant {name!r} rate-limited: retry in "
+                        f"{wait:.3f}s", tq, wait, name)
+        tid = self._tenant_ids.setdefault(name, len(self._tenant_ids))
+        # Per-tenant SLO accounting only for REAL tenants (registered,
+        # or explicitly named on submit): the trainer/generate() path
+        # runs everything under the implicit "default" tenant, and
+        # routing it per-tenant would just shadow every global
+        # histogram with a duplicate tenant_default_* column set.
+        slo_tenant = (name if (qos is not None or name != "default")
+                      else None)
         dl = -1 if deadline is None else int(deadline)
         hashes = self._page_hashes(ids)
         if k > 1:
             self.sched.add_group(req_id, len(ids), budget, k,
                                  priority=priority, deadline=dl,
-                                 prefix_hashes=hashes)
+                                 prefix_hashes=hashes, tenant=tid)
         else:
             self.sched.add(req_id, len(ids), budget, priority=priority,
-                           deadline=dl, prefix_hashes=hashes)
+                           deadline=dl, prefix_hashes=hashes, tenant=tid)
         for j in range(k):
             self._reqinfo[req_id + j] = (ids, budget, req_id, j, k)
-            self.telemetry.mark(req_id + j, "submit",
-                                prompt_len=len(ids), budget=budget)
+            self._req_tenant[req_id + j] = name
+            self._tenant_queued[name] = \
+                self._tenant_queued.get(name, 0) + 1
+            if stream:
+                self._streams[req_id + j] = {
+                    "emitted": 0, "chunks": [], "restarted": False,
+                    "done": False, "completed": None, "cb": on_tokens}
+            if slo_tenant is not None:
+                self.telemetry.mark(req_id + j, "submit",
+                                    prompt_len=len(ids), budget=budget,
+                                    tenant=slo_tenant)
+            else:
+                self.telemetry.mark(req_id + j, "submit",
+                                    prompt_len=len(ids), budget=budget)
 
     @property
     def pending(self) -> int:
         """Requests submitted but not yet returned by ``step``."""
         return len(self._reqinfo)
 
-    def _preempt_req(self, rid: int) -> None:
+    def _preempt_req(self, rid: int, count: bool = True) -> None:
         """Recompute-preemption: drop the victim's pages/slot back to
         the pool and requeue it (the scheduler keeps its arrival
         position); its partial completion is discarded and it restarts
         from the prompt when readmitted.  The victim's zombie slot
         keeps lockstep-decoding into the scratch page until the slot is
-        re-seeded by a later admission — masked work, never a hazard."""
+        re-seeded by a later admission — masked work, never a hazard.
+        ``count=False`` skips the preemption metrics (the cancel path
+        reuses this machinery to evict a decoding request but is not a
+        recompute-restart)."""
         slot = self.sched.slot(rid)
         self.sched.preempt(rid)
         ids, budget, head, j, k = self._reqinfo[rid]
@@ -1112,8 +1295,110 @@ class ContinuousBatchingEngine:
         self._accept_ema.pop(rid, None)  # re-seeded at readmission
         self._bt[slot, :] = self._scratch
         self._bt_dev = None
-        self.preemptions += 1
-        self.telemetry.preempt(rid)
+        # Back to waiting: the tenant's queue-cap ledger re-counts it.
+        name = self._req_tenant.get(rid)
+        if name is not None:
+            self._tenant_queued[name] = \
+                self._tenant_queued.get(name, 0) + 1
+        # A streaming victim restarts its stream: everything delivered
+        # so far is discarded by the client (restart-by-recompute will
+        # re-derive it) and the next chunk carries ``restarted``.
+        st = self._streams.get(rid)
+        if st is not None:
+            st["emitted"] = 0
+            st["chunks"] = []
+            st["restarted"] = True
+        if count:
+            self.preemptions += 1
+            self.telemetry.preempt(rid)
+
+    # -- request abort (PR 12) ------------------------------------------
+    def _in_prefill(self, rid: int) -> bool:
+        return any(rid == r
+                   for e in self._prefilling.values()
+                   for r, _slot in e["slots"].values())
+
+    def _drop_request(self, rid: int) -> None:
+        """Forget every engine-side trace of an aborted request (its
+        scheduler entry must already be gone)."""
+        name = self._req_tenant.pop(rid, None)
+        if name is not None:
+            self._tenant_queued[name] = \
+                max(0, self._tenant_queued.get(name, 0) - 1)
+        del self._reqinfo[rid]
+        self._admit_seq.pop(rid, None)
+        self._accept_ema.pop(rid, None)
+        self._streams.pop(rid, None)
+        self.telemetry.drop(rid)
+        self.cancelled_requests += 1
+
+    def cancel(self, req_id: int) -> bool:
+        """Abort an in-flight request (PR 12 — the gateway's CANCEL
+        path).  A waiting request is dequeued immediately; a decoding
+        request is evicted through the preemption machinery (pages
+        freed at this step boundary) and dequeued; a request
+        mid-chunked-prefill is deferred one wave (its pages are being
+        written by an in-flight group program) and aborted at the next
+        ``step()``.  Returns True when the abort completed now, False
+        when deferred.  Raises KeyError for unknown ids and ValueError
+        for k-clone group members (groups share prompt pages; abort
+        the whole group by cancelling each clone after activation)."""
+        rid = int(req_id)
+        if rid not in self._reqinfo:
+            raise KeyError(rid)
+        ids, budget, head, j, k = self._reqinfo[rid]
+        if k > 1:
+            raise ValueError(
+                f"request {rid} is a k-clone group member; group "
+                "cancellation is not supported mid-prefill")
+        if self._in_prefill(rid):
+            self._cancels.add(rid)
+            return False
+        try:
+            slot = self.sched.slot(rid)
+        except KeyError:
+            slot = None
+        if slot is not None and self._phase[slot] == _DECODE \
+                and int(self._slot_req[slot]) == rid:
+            # Evict via the preemption machinery (frees pages + slot,
+            # requeues as waiting), then drop the requeued entry.  A
+            # finished-but-unharvested request takes the same path:
+            # its pending done-flag snapshot is disarmed by the
+            # admission-seq pairing once the slot resets.
+            self._preempt_req(rid, count=False)
+        self.sched.cancel(rid)
+        self._drop_request(rid)
+        return True
+
+    def poll(self, req_id: int) -> Optional[StreamChunk]:
+        """Drain a streaming request's buffered output (pull surface —
+        push callers pass ``on_tokens`` to submit instead).  Returns
+        None when nothing new arrived since the last poll; the final
+        chunk has ``done=True`` and the full :class:`CompletedRequest`
+        attached, after which the request id is forgotten.  Raises
+        KeyError for ids not submitted with ``stream=True`` (or
+        already drained)."""
+        rid = int(req_id)
+        st = self._streams.get(rid)
+        if st is None:
+            raise KeyError(f"request {rid} is not streaming "
+                           "(or its stream already drained)")
+        if st["cb"] is not None:
+            raise ValueError(
+                f"request {rid} streams through its on_tokens "
+                "callback; poll() is for callback-less streams")
+        if not st["chunks"] and not st["done"] and not st["restarted"]:
+            return None
+        toks = (np.concatenate(st["chunks"])
+                if st["chunks"] else np.empty(0, np.int32))
+        chunk = StreamChunk(req_id=rid, tokens=toks, done=st["done"],
+                            restarted=st["restarted"],
+                            completed=st["completed"])
+        st["chunks"] = []
+        st["restarted"] = False
+        if st["done"]:
+            del self._streams[rid]
+        return chunk
 
     def _extend_running(self, spec_wave: bool = False) -> None:
         """Grow every decoding slot's reservation to cover the next
@@ -1336,6 +1621,15 @@ class ContinuousBatchingEngine:
     def _step_wave(self) -> List[CompletedRequest]:
         self._early_out = []
 
+        # -- deferred aborts: a cancel that landed mid-chunked-prefill
+        #    is applied at this wave boundary (activation flipped the
+        #    request to decoding, where the preemption machinery can
+        #    free its pages safely) ---------------------------------------
+        for rid in list(self._cancels):
+            self._cancels.discard(rid)
+            if rid in self._reqinfo:
+                self.cancel(rid)
+
         # -- admission (between jitted segments) ------------------------
         admitted = self.sched.admit()
         if (not admitted and not self.sched.running
@@ -1351,6 +1645,10 @@ class ContinuousBatchingEngine:
             self._phase[slot] = _PREFILL
             self._admit_seq[rid] = self._admit_counter
             self._admit_counter += 1
+            name = self._req_tenant.get(rid)
+            if name is not None:  # left the waiting queue: QoS ledger
+                self._tenant_queued[name] = \
+                    max(0, self._tenant_queued.get(name, 0) - 1)
             self.telemetry.mark(rid, "admit", slot=slot)
             if j == 0:
                 cached = self.sched.cached_count(rid)
@@ -1426,13 +1724,32 @@ class ContinuousBatchingEngine:
             # previous fetch to feed the acceptance EMAs and engine
             # totals, and the match bit feeds the next wave's verify
             # decision.
+            # Streaming (PR 12): when a streaming request occupies a
+            # decode slot, the wave's token buffer rides the SAME
+            # lagged snapshot (one extra [S, T] device copy; ~50 KB at
+            # the tiny shape) so incremental emission shares the flag
+            # fetch's pairing guard — tokens can only ever be emitted
+            # for the admission they were decoded under.  Non-streaming
+            # traffic pays nothing.
+            stream_live = bool(self._streams) and any(
+                self._phase[s] == _DECODE
+                and int(self._slot_req[s]) in self._streams
+                for s in range(self.slots))
             snap_in = [self._state["done"], self._state["n_new"]]
             if self._spec:
                 snap_in.append(self._state["spec_counts"])
+            if stream_live:
+                snap_in.append(self._state["toks"])
             snap = self._jit_snap(*snap_in)
-            flags = (snap[0], snap[1],
-                     np.where(self._phase == _DECODE,
-                              self._slot_seq, -1)) + tuple(snap[2:])
+            flags = {"done": snap[0], "n_new": snap[1],
+                     "seq": np.where(self._phase == _DECODE,
+                                     self._slot_seq, -1)}
+            i = 2
+            if self._spec:
+                flags["counts"] = snap[i]
+                i += 1
+            if stream_live:
+                flags["toks"] = snap[i]
         else:
             flags = None
 
@@ -1553,25 +1870,73 @@ class ContinuousBatchingEngine:
                     self._EMA_GLOBAL * rate
                     + (1 - self._EMA_GLOBAL) * self._spec_global_ema)
 
+    def _emit_stream_chunks(self, toks_h, n_new_h, snap_seq) -> None:
+        """Route this snapshot's newly decoded tokens to their
+        streaming requests (buffered for ``poll``, or pushed through
+        the submit-time callback).  Guarded by the same admission-seq
+        pairing as the done flags: a slot's tokens only ever stream to
+        the admission they were decoded for."""
+        for s in range(self.slots):
+            if self._phase[s] != _DECODE or self._slot_seq[s] != snap_seq[s]:
+                continue
+            rid = int(self._slot_req[s])
+            st = self._streams.get(rid)
+            if st is None:
+                continue
+            n = int(n_new_h[s])
+            if n <= st["emitted"]:
+                continue
+            new = np.asarray(toks_h[s, st["emitted"]:n], np.int32).copy()
+            st["emitted"] = n
+            if st["cb"] is not None:
+                restarted = st["restarted"]
+                st["restarted"] = False
+                st["cb"](StreamChunk(req_id=rid, tokens=new,
+                                     restarted=restarted))
+            else:
+                st["chunks"].append(new)
+
+    def _finish_stream(self, rid: int, rows_t, n: int,
+                       completed: CompletedRequest) -> None:
+        """Final stream delivery for a harvested request: whatever the
+        per-wave snapshots had not yet emitted, plus the completed
+        record, with ``done=True``."""
+        st = self._streams.get(rid)
+        if st is None:
+            return
+        tail = np.asarray(rows_t[st["emitted"]:n], np.int32).copy()
+        st["emitted"] = n
+        st["done"] = True
+        st["completed"] = completed
+        if st["cb"] is not None:
+            restarted = st["restarted"]
+            st["cb"](StreamChunk(req_id=rid, tokens=tail, done=True,
+                                 restarted=restarted,
+                                 completed=completed))
+            del self._streams[rid]  # pushed: nothing left to poll
+        else:
+            st["chunks"].append(tail)
+
     def _harvest_pending(self) -> List[CompletedRequest]:
-        """Process the pending done-flag snapshot (if any): fetch the
-        finished slots' completion rows, retire them with the scheduler
-        (pages free here), and return the completions.  Clears the
-        pending snapshot."""
+        """Process the pending snapshot (if any): emit stream chunks,
+        fetch the finished slots' completion rows, retire them with
+        the scheduler (pages free here), and return the completions.
+        Clears the pending snapshot."""
         out: List[CompletedRequest] = []
         if self._pending_flags is None:
             return out
-        counts_h = None
-        if self._spec:
-            done_d, n_new_d, snap_seq, counts_d = self._pending_flags
-            self._pending_flags = None
-            done_h, n_new_h, counts_h = jax.device_get(
-                (done_d, n_new_d, counts_d))
+        pf = self._pending_flags
+        self._pending_flags = None
+        fetch = {k: pf[k] for k in ("done", "n_new", "counts", "toks")
+                 if k in pf}
+        fetched = jax.device_get(fetch)
+        done_h, n_new_h = fetched["done"], fetched["n_new"]
+        snap_seq = pf["seq"]
+        counts_h = fetched.get("counts")
+        if counts_h is not None:
             self._spec_accounting(snap_seq, counts_h)
-        else:
-            done_d, n_new_d, snap_seq = self._pending_flags
-            self._pending_flags = None
-            done_h, n_new_h = jax.device_get((done_d, n_new_d))
+        if "toks" in fetched:
+            self._emit_stream_chunks(fetched["toks"], n_new_h, snap_seq)
         finished = [s for s in range(self.slots)
                     if self._slot_req[s] >= 0
                     and self._phase[s] == _DECODE
@@ -1596,6 +1961,8 @@ class ContinuousBatchingEngine:
                     logprobs=rows_h["l"][s][:n].astype(np.float32),
                     policy_logprobs=rows_h["p"][s][:n].astype(
                         np.float32)))
+                self._finish_stream(rid, rows_h["t"][s], n, out[-1])
+                self._req_tenant.pop(rid, None)
                 self.sched.finish(rid)
                 self.telemetry.finish(rid, n)
                 if self._spec:
@@ -1625,6 +1992,10 @@ class ContinuousBatchingEngine:
         stats["preempted_requests"] = float(self.preemptions)
         stats["prefix_cached_pages"] = float(self.prefix_cached_pages)
         stats["page_pool_size"] = float(self.num_pages)
+        # Multi-tenant QoS counters (PR 12): per-tenant SLO histograms
+        # already ride telemetry.summary() as tenant_<name>_* keys.
+        stats["shed_requests"] = float(self.shed_requests)
+        stats["cancelled_requests"] = float(self.cancelled_requests)
         # Speculative decoding v2 counters (zero when spec is off):
         # drafted/accepted reconcile with emitted tokens as
         # accepted + resampled == tokens emitted by verify segments.
@@ -1647,11 +2018,14 @@ class ContinuousBatchingEngine:
         self._waves_since_spec = 0
 
     def reset_server_stats(self) -> None:
-        """Drop accumulated telemetry/counters (bench measurement
-        windows); in-flight request marks survive."""
+        """Drop accumulated telemetry/counters — including every
+        per-tenant histogram/counter (``tenant_<name>_*``) — for bench
+        measurement windows; in-flight request marks survive."""
         self.telemetry.reset()
         self.preemptions = 0
         self.prefix_cached_pages = 0
+        self.shed_requests = 0
+        self.cancelled_requests = 0
         self.spec_drafted = 0
         self.spec_accepted = 0
         self.spec_resampled = 0

@@ -36,12 +36,25 @@
 // All decisions are deterministic and bit-identically mirrored by the
 // pure-Python PyScheduler (cross-checked in tests/test_runtime_native).
 //
+// Multi-tenant weighted-fair admission (PR 12 serving QoS): every
+// request carries a tenant id; admission first picks the backlogged
+// tenant with the LOWEST virtual service (vserv += admitted tokens *
+// kVScale / weight — all-integer, so both implementations agree bit
+// for bit), then applies the configured policy WITHIN that tenant.
+// A tenant re-entering the backlog catches its virtual clock up to
+// the last admission's level, so an idle tenant can neither hoard
+// credit nor be starved on return.  One tenant degrades exactly to
+// the pre-PR12 single-queue behavior.  Cancel() removes a waiting
+// request (the engine's request-abort path; running requests are
+// preempted first, which requeues them as waiting).
+//
 // C ABI (extern "C") for ctypes; handles are opaque pointers.
 
 #include <cstdint>
 #include <deque>
 #include <list>
 #include <unordered_map>
+#include <unordered_set>
 #include <vector>
 
 namespace {
@@ -58,6 +71,7 @@ struct Request {
   int group_k = 1;        // waiting entries: clones in this group
   int priority = 0;       // larger = admitted sooner (PRIORITY policy)
   int64_t deadline = kNoDeadline;  // EDF key (DEADLINE policy)
+  int64_t tenant = 0;     // weighted-fair admission class
   int64_t seq = 0;        // arrival order; preserved across preemption
   int slot = -1;
   int cached_count = 0;   // leading pages shared via the prefix cache
@@ -89,6 +103,22 @@ struct CachedPage {
   bool orphan = false;
 };
 
+// Weighted-fair admission state per tenant.  vserv is the tenant's
+// cumulative NORMALIZED service in integer virtual units (admitted
+// prompt+budget tokens * kVScale / weight): the next admission always
+// goes to the backlogged tenant with the smallest vserv, so a
+// weight-4 tenant receives ~4x the admitted tokens of a weight-1
+// tenant under contention.  All-integer so the C++ and Python
+// schedulers agree bit for bit.
+struct Tenant {
+  int64_t weight = 1;
+  int64_t vserv = 0;
+  int64_t max_running = 0;  // concurrency cap (slots); 0 = unlimited
+  int64_t running = 0;      // members currently admitted
+};
+
+constexpr int64_t kVScale = 4096;
+
 class Scheduler {
  public:
   Scheduler(int num_pages, int page_size, int max_slots, int watermark,
@@ -105,17 +135,45 @@ class Scheduler {
   }
 
   int Add(int64_t id, int prompt_len, int max_new, int priority,
-          int64_t deadline, const int64_t* hashes, int n_hashes) {
+          int64_t deadline, const int64_t* hashes, int n_hashes,
+          int64_t tenant) {
     return Enqueue(id, prompt_len, max_new, 1, priority, deadline, hashes,
-                   n_hashes, seq_counter_++);
+                   n_hashes, tenant, seq_counter_++);
   }
 
   int AddGroup(int64_t first_id, int prompt_len, int max_new, int k,
                int priority, int64_t deadline, const int64_t* hashes,
-               int n_hashes) {
+               int n_hashes, int64_t tenant) {
     if (k < 1 || k > max_slots_) return -1;
     return Enqueue(first_id, prompt_len, max_new, k, priority, deadline,
-                   hashes, n_hashes, seq_counter_++);
+                   hashes, n_hashes, tenant, seq_counter_++);
+  }
+
+  // Register (or update) a tenant's weighted-fair share and
+  // concurrency cap.  Weight must be >= 1; max_running caps how many
+  // of the tenant's requests may be admitted at once (reserved-
+  // capacity QoS: a best-effort flood cannot occupy every slot
+  // between a paying tenant's arrivals), 0 = unlimited.  Unknown
+  // tenants default to weight 1 / unlimited on first use.
+  int SetTenant(int64_t tenant, int64_t weight, int64_t max_running) {
+    if (weight < 1 || max_running < 0) return -1;
+    Tenant& t = tenants_[tenant];
+    t.weight = weight;
+    t.max_running = max_running;
+    return 0;
+  }
+
+  // Remove a WAITING request (the engine's abort path — a running
+  // request is preempted first, which requeues it as waiting).
+  // Returns 0, or -1 when no waiting entry carries the id.
+  int Cancel(int64_t id) {
+    for (auto it = waiting_.begin(); it != waiting_.end(); ++it) {
+      if (it->id == id) {
+        waiting_.erase(it);
+        return 0;
+      }
+    }
+    return -1;
   }
 
   // Admit waiting requests in policy order while slots + pages last.
@@ -129,6 +187,7 @@ class Scheduler {
     int n = 0;
     while (!waiting_.empty() && !free_slots_.empty()) {
       std::size_t pick = SelectWaiting();
+      if (pick >= waiting_.size()) break;  // every tenant at its cap
       Request& head = waiting_[pick];
       int k = head.group_k;
       int full_prompt = head.prompt_len / page_size_;
@@ -139,13 +198,39 @@ class Scheduler {
       int shared_new = full_prompt - cached;
       int need_new = shared_new + k;
       int headroom = (!running_.empty() || n > 0) ? watermark_ : 0;
+      // Cached prefix pages this admission will REF (refs 0 -> k)
+      // leave the available pool the moment they are claimed, so the
+      // availability check must cover them too: counting a page both
+      // as "available to allocate" and as "the shared prefix we are
+      // about to pin" let a tight pool allocate past empty (latent
+      // since PR 8; found by ASan under the PR 12 randomized drive —
+      // AllocPage().pop_front() on an empty avail_ list is UB).
+      int refed_avail = 0;
+      {
+        std::unordered_set<int32_t> seen_pages;
+        for (int i = 0; i < cached; ++i) {
+          int32_t p = cache_map_.at(head.hashes[i]);
+          if (seen_pages.insert(p).second &&
+              cached_pages_.at(p).refs == 0)
+            ++refed_avail;
+        }
+      }
       // Stop at the first request that does not fit: no overtaking
       // within the policy order (starvation-free and deterministic).
       if (n + k > max_out) break;
       if (static_cast<int>(free_slots_.size()) < k) break;
-      if (AvailablePages() < need_new + headroom) break;
+      if (AvailablePages() < need_new + refed_avail + headroom) break;
       Request proto = std::move(head);
       waiting_.erase(waiting_.begin() + pick);
+      // Weighted-fair accounting: the admitted tenant's virtual
+      // service advances by its normalized token cost, and the global
+      // virtual clock tracks the last admission's level (the re-entry
+      // floor for tenants returning to the backlog).
+      Tenant& ten = tenants_.at(proto.tenant);
+      ten.vserv += static_cast<int64_t>(proto.prompt_len + proto.max_new) *
+                   k * kVScale / ten.weight;
+      ten.running += k;
+      vclock_ = ten.vserv;
       std::vector<int32_t> cached_pages;
       cached_pages.reserve(cached);
       for (int i = 0; i < cached; ++i) {
@@ -257,6 +342,7 @@ class Scheduler {
     if (it == running_.end()) return -1;
     Request r = std::move(it->second);
     running_.erase(it);
+    tenants_.at(r.tenant).running -= 1;
     int freed = 0;
     for (int i = 0; i < r.cached_count; ++i) UnrefCached(r.pages[i]);
     int priv_start = r.cached_count + r.shared_count;
@@ -292,6 +378,7 @@ class Scheduler {
     if (it == running_.end()) return -1;
     Request r = std::move(it->second);
     running_.erase(it);
+    tenants_.at(r.tenant).running -= 1;
     for (int i = 0; i < r.cached_count; ++i) UnrefCached(r.pages[i]);
     int priv_start = r.cached_count + r.shared_count;
     for (std::size_t i = priv_start; i < r.pages.size(); ++i)
@@ -311,8 +398,10 @@ class Scheduler {
     w.group_k = 1;
     w.priority = r.priority;
     w.deadline = r.deadline;
+    w.tenant = r.tenant;
     w.hashes = std::move(r.hashes);
     w.seq = r.seq;
+    CatchUp(w.tenant);
     std::size_t pos = 0;
     while (pos < waiting_.size() && waiting_[pos].seq < w.seq) ++pos;
     waiting_.insert(waiting_.begin() + pos, std::move(w));
@@ -354,7 +443,7 @@ class Scheduler {
  private:
   int Enqueue(int64_t id, int prompt_len, int max_new, int k, int priority,
               int64_t deadline, const int64_t* hashes, int n_hashes,
-              int64_t seq) {
+              int64_t tenant, int64_t seq) {
     Request r;
     r.id = id;
     r.prompt_len = prompt_len;
@@ -362,7 +451,9 @@ class Scheduler {
     r.group_k = k;
     r.priority = priority;
     r.deadline = deadline;
+    r.tenant = tenant;
     r.seq = seq;
+    CatchUp(tenant);
     // Engine-capped: at most (prompt_len - 1) / page_size hashes, so a
     // fully-cached prompt still re-forwards >= 1 real token for its
     // first-sample logits.  Clamp here so a buggy caller cannot make
@@ -374,22 +465,67 @@ class Scheduler {
     return 0;
   }
 
+  // A tenant (re-)entering the backlog catches its virtual clock up
+  // to the last admission's level: an idle tenant must not bank
+  // credit (it would monopolize admission on return), and a new
+  // tenant starts level with the field instead of behind it.  Called
+  // BEFORE the entry is inserted, so "already backlogged" is judged
+  // on the pre-insert queue.
+  void CatchUp(int64_t tenant) {
+    for (const Request& w : waiting_)
+      if (w.tenant == tenant) return;  // already backlogged: no-op
+    Tenant& t = tenants_[tenant];
+    if (t.vserv < vclock_) t.vserv = vclock_;
+  }
+
+  bool PolicyBetter(const Request& a, const Request& b) const {
+    if (policy_ == kPolicyFifo) return a.seq < b.seq;
+    if (policy_ == kPolicyPriority)
+      return a.priority > b.priority ||
+             (a.priority == b.priority && a.seq < b.seq);
+    // kPolicyDeadline: EDF, no-deadline sorts last
+    int64_t da = a.deadline == kNoDeadline ? INT64_MAX : a.deadline;
+    int64_t db = b.deadline == kNoDeadline ? INT64_MAX : b.deadline;
+    return da < db || (da == db && a.seq < b.seq);
+  }
+
+  // Returns waiting_.size() when no tenant may admit (all at their
+  // concurrency caps).  Pick order: each tenant's POLICY HEAD (no
+  // overtaking within a tenant), tenants filtered by max_running,
+  // then the lowest-virtual-service eligible tenant (ties: smaller
+  // tenant id).  With one uncapped tenant this degrades exactly to
+  // the pre-PR12 single-queue order.
   std::size_t SelectWaiting() const {
-    if (policy_ == kPolicyFifo) return 0;
-    std::size_t best = 0;
-    for (std::size_t i = 1; i < waiting_.size(); ++i) {
-      const Request& a = waiting_[i];
-      const Request& b = waiting_[best];
-      bool better;
-      if (policy_ == kPolicyPriority) {
-        better = a.priority > b.priority ||
-                 (a.priority == b.priority && a.seq < b.seq);
-      } else {  // kPolicyDeadline: EDF, no-deadline sorts last
-        int64_t da = a.deadline == kNoDeadline ? INT64_MAX : a.deadline;
-        int64_t db = b.deadline == kNoDeadline ? INT64_MAX : b.deadline;
-        better = da < db || (da == db && a.seq < b.seq);
+    std::unordered_map<int64_t, std::size_t> heads;
+    for (std::size_t i = 0; i < waiting_.size(); ++i) {
+      auto it = heads.find(waiting_[i].tenant);
+      if (it == heads.end()) {
+        heads.emplace(waiting_[i].tenant, i);
+      } else if (PolicyBetter(waiting_[i], waiting_[it->second])) {
+        it->second = i;
       }
-      if (better) best = i;
+    }
+    std::size_t best = waiting_.size();
+    int64_t best_t = 0;
+    // (map iteration order is implementation-defined, but the
+    // (vserv, tenant id) comparison below is a total order, so the
+    // pick is deterministic and matches the Python mirror.)
+    for (const auto& kv : heads) {
+      const Tenant& t = tenants_.at(kv.first);
+      if (t.max_running > 0 &&
+          t.running + waiting_[kv.second].group_k > t.max_running)
+        continue;  // at its concurrency cap: its queue waits
+      if (best == waiting_.size()) {
+        best = kv.second;
+        best_t = kv.first;
+        continue;
+      }
+      int64_t va = t.vserv;
+      int64_t vb = tenants_.at(best_t).vserv;
+      if (va < vb || (va == vb && kv.first < best_t)) {
+        best = kv.second;
+        best_t = kv.first;
+      }
     }
     return best;
   }
@@ -455,6 +591,8 @@ class Scheduler {
   int watermark_;
   int policy_;
   int64_t seq_counter_ = 0;
+  int64_t vclock_ = 0;  // last admission's normalized service level
+  std::unordered_map<int64_t, Tenant> tenants_;
   std::vector<int32_t> free_pages_;
   std::vector<int32_t> free_slots_;
   std::deque<Request> waiting_;
@@ -480,17 +618,28 @@ void* osch_create(int num_pages, int page_size, int max_slots, int watermark,
 void osch_destroy(void* h) { delete static_cast<Scheduler*>(h); }
 
 int osch_add(void* h, int64_t id, int prompt_len, int max_new, int priority,
-             int64_t deadline, const int64_t* hashes, int n_hashes) {
+             int64_t deadline, const int64_t* hashes, int n_hashes,
+             int64_t tenant) {
   return static_cast<Scheduler*>(h)->Add(id, prompt_len, max_new, priority,
-                                         deadline, hashes, n_hashes);
+                                         deadline, hashes, n_hashes, tenant);
 }
 
 int osch_add_group(void* h, int64_t first_id, int prompt_len, int max_new,
                    int k, int priority, int64_t deadline,
-                   const int64_t* hashes, int n_hashes) {
+                   const int64_t* hashes, int n_hashes, int64_t tenant) {
   return static_cast<Scheduler*>(h)->AddGroup(first_id, prompt_len, max_new,
                                               k, priority, deadline, hashes,
-                                              n_hashes);
+                                              n_hashes, tenant);
+}
+
+int osch_set_tenant(void* h, int64_t tenant, int64_t weight,
+                    int64_t max_running) {
+  return static_cast<Scheduler*>(h)->SetTenant(tenant, weight,
+                                               max_running);
+}
+
+int osch_cancel(void* h, int64_t id) {
+  return static_cast<Scheduler*>(h)->Cancel(id);
 }
 
 int osch_admit(void* h, int64_t* out_ids, int32_t* out_slots, int max_out) {

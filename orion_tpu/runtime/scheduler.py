@@ -20,6 +20,16 @@ deadline-EDF, no overtaking within the order).  Cross-request prefix
 caching shares hash-matched full prompt pages read-only (refcounted,
 LRU-evictable at refs==0, graduated into the cache by ``finish``).
 LIFO page reuse.
+
+PR 12 (multi-tenant serving QoS): every request carries a ``tenant``
+id; admission first picks the backlogged tenant with the lowest
+integer virtual service (``vserv += admitted_tokens * 4096 //
+weight``), filtered by each tenant's ``max_running`` concurrency cap
+(reserved capacity), then applies the configured policy within that
+tenant — register envelopes via ``set_tenant(tenant, weight,
+max_running)``.  One uncapped tenant degrades exactly to the
+single-queue order.  ``cancel`` removes a waiting request (the
+engine's abort path).
 """
 
 from __future__ import annotations
@@ -148,12 +158,17 @@ def _bind(so: Optional[str]):
     lib.osch_add.restype = ctypes.c_int
     lib.osch_add.argtypes = [ctypes.c_void_p, ctypes.c_int64, ctypes.c_int,
                              ctypes.c_int, ctypes.c_int, ctypes.c_int64,
-                             i64p, ctypes.c_int]
+                             i64p, ctypes.c_int, ctypes.c_int64]
     lib.osch_add_group.restype = ctypes.c_int
     lib.osch_add_group.argtypes = [ctypes.c_void_p, ctypes.c_int64,
                                    ctypes.c_int, ctypes.c_int, ctypes.c_int,
                                    ctypes.c_int, ctypes.c_int64, i64p,
-                                   ctypes.c_int]
+                                   ctypes.c_int, ctypes.c_int64]
+    lib.osch_set_tenant.restype = ctypes.c_int
+    lib.osch_set_tenant.argtypes = [ctypes.c_void_p, ctypes.c_int64,
+                                    ctypes.c_int64, ctypes.c_int64]
+    lib.osch_cancel.restype = ctypes.c_int
+    lib.osch_cancel.argtypes = [ctypes.c_void_p, ctypes.c_int64]
     lib.osch_admit.restype = ctypes.c_int
     lib.osch_admit.argtypes = [ctypes.c_void_p, i64p, i32p, ctypes.c_int]
     lib.osch_pages.restype = ctypes.c_int
@@ -208,20 +223,38 @@ class _NativeScheduler:
 
     def add(self, req_id: int, prompt_len: int, max_new: int,
             priority: int = 0, deadline: int = NO_DEADLINE,
-            prefix_hashes: Sequence[int] = ()) -> None:
+            prefix_hashes: Sequence[int] = (), tenant: int = 0) -> None:
         buf, n = _hash_buf(prefix_hashes)
         self._lib.osch_add(self._h, req_id, prompt_len, max_new, priority,
-                           deadline, buf, n)
+                           deadline, buf, n, tenant)
 
     def add_group(self, first_id: int, prompt_len: int, max_new: int,
                   k: int, priority: int = 0, deadline: int = NO_DEADLINE,
-                  prefix_hashes: Sequence[int] = ()) -> None:
+                  prefix_hashes: Sequence[int] = (),
+                  tenant: int = 0) -> None:
         buf, n = _hash_buf(prefix_hashes)
         if self._lib.osch_add_group(self._h, first_id, prompt_len, max_new,
-                                    k, priority, deadline, buf, n) != 0:
+                                    k, priority, deadline, buf, n,
+                                    tenant) != 0:
             raise ValueError(
                 f"group of {k} clones can never be admitted "
                 f"(max_slots={self.max_slots})")
+
+    def set_tenant(self, tenant: int, weight: int = 1,
+                   max_running: int = 0) -> None:
+        """Register a tenant's weighted-fair share (weight >= 1) and
+        concurrency cap (max admitted members; 0 = unlimited)."""
+        if self._lib.osch_set_tenant(self._h, tenant, weight,
+                                     max_running) != 0:
+            raise ValueError(
+                f"bad tenant params: weight={weight} (>= 1), "
+                f"max_running={max_running} (>= 0)")
+
+    def cancel(self, req_id: int) -> None:
+        """Remove a WAITING request (running ones are preempted first
+        by the engine, which requeues them as waiting)."""
+        if self._lib.osch_cancel(self._h, req_id) < 0:
+            raise KeyError(req_id)
 
     def admit(self, max_out: Optional[int] = None) -> List[Tuple[int, int]]:
         if max_out is None:
@@ -326,27 +359,45 @@ class PyScheduler:
         self._cache_map: dict = {}     # hash -> page
         self._cached_pages: dict = {}  # page -> [hash, refs, orphan]
         self._avail: list = []         # refs==0 cached pages, LRU order
+        self._tenants: dict = {}       # tenant -> [weight, vserv]
+        self._vclock = 0               # last admission's service level
         self.max_slots = max_slots
 
+    _VSCALE = 4096  # integer virtual-service scale (mirror of kVScale)
+
     # -- enqueue --------------------------------------------------------
+    def _catch_up(self, tenant) -> None:
+        """A tenant (re-)entering the backlog catches its virtual
+        clock up to the last admission's level — idle tenants bank no
+        credit, new tenants start level with the field.  Judged on the
+        PRE-insert queue (mirror of the native CatchUp)."""
+        for w in self._waiting:
+            if w["tenant"] == tenant:
+                return
+        t = self._tenants.setdefault(tenant, [1, 0, 0, 0])
+        if t[1] < self._vclock:
+            t[1] = self._vclock
+
     def _enqueue(self, req_id, prompt_len, max_new, k, priority, deadline,
-                 hashes):
+                 hashes, tenant):
         cap = (prompt_len - 1) // self._ps if prompt_len > 0 else 0
+        self._catch_up(tenant)
         self._waiting.append({
             "id": req_id, "plen": prompt_len, "mnew": max_new, "k": k,
-            "prio": priority, "deadline": deadline,
+            "prio": priority, "deadline": deadline, "tenant": tenant,
             "hashes": list(hashes)[:cap], "seq": self._seq})
         self._seq += 1
 
     def add(self, req_id: int, prompt_len: int, max_new: int,
             priority: int = 0, deadline: int = NO_DEADLINE,
-            prefix_hashes: Sequence[int] = ()) -> None:
+            prefix_hashes: Sequence[int] = (), tenant: int = 0) -> None:
         self._enqueue(req_id, prompt_len, max_new, 1, priority, deadline,
-                      prefix_hashes)
+                      prefix_hashes, tenant)
 
     def add_group(self, first_id: int, prompt_len: int, max_new: int,
                   k: int, priority: int = 0, deadline: int = NO_DEADLINE,
-                  prefix_hashes: Sequence[int] = ()) -> None:
+                  prefix_hashes: Sequence[int] = (),
+                  tenant: int = 0) -> None:
         """Shared-prefix sampling group: k clones (ids first_id ..
         first_id+k-1) of one prompt; the group's freshly-computed full
         prompt pages are allocated once and refcounted.  Admission is
@@ -356,7 +407,28 @@ class PyScheduler:
                 f"group of {k} clones can never be admitted "
                 f"(max_slots={self.max_slots})")
         self._enqueue(first_id, prompt_len, max_new, k, priority, deadline,
-                      prefix_hashes)
+                      prefix_hashes, tenant)
+
+    def set_tenant(self, tenant: int, weight: int = 1,
+                   max_running: int = 0) -> None:
+        """Register a tenant's weighted-fair share (weight >= 1) and
+        concurrency cap (max admitted members; 0 = unlimited)."""
+        if weight < 1 or max_running < 0:
+            raise ValueError(
+                f"bad tenant params: weight={weight} (>= 1), "
+                f"max_running={max_running} (>= 0)")
+        t = self._tenants.setdefault(tenant, [1, 0, 0, 0])
+        t[0] = weight
+        t[2] = max_running
+
+    def cancel(self, req_id: int) -> None:
+        """Remove a WAITING request (running ones are preempted first
+        by the engine, which requeues them as waiting)."""
+        for i, w in enumerate(self._waiting):
+            if w["id"] == req_id:
+                del self._waiting[i]
+                return
+        raise KeyError(req_id)
 
     # -- page bookkeeping ----------------------------------------------
     def _available(self) -> int:
@@ -396,23 +468,41 @@ class PyScheduler:
         return 1
 
     # -- admission ------------------------------------------------------
-    def _select_waiting(self) -> int:
+    def _policy_better(self, a, b) -> bool:
         if self._policy == POLICIES["fifo"]:
-            return 0
-        best = 0
-        for i in range(1, len(self._waiting)):
-            a, b = self._waiting[i], self._waiting[best]
-            if self._policy == POLICIES["priority"]:
-                better = (a["prio"] > b["prio"]
-                          or (a["prio"] == b["prio"]
-                              and a["seq"] < b["seq"]))
-            else:  # deadline: EDF, no-deadline sorts last
-                inf = (1 << 63) - 1
-                da = inf if a["deadline"] == NO_DEADLINE else a["deadline"]
-                db = inf if b["deadline"] == NO_DEADLINE else b["deadline"]
-                better = da < db or (da == db and a["seq"] < b["seq"])
-            if better:
-                best = i
+            return a["seq"] < b["seq"]
+        if self._policy == POLICIES["priority"]:
+            return (a["prio"] > b["prio"]
+                    or (a["prio"] == b["prio"] and a["seq"] < b["seq"]))
+        # deadline: EDF, no-deadline sorts last
+        inf = (1 << 63) - 1
+        da = inf if a["deadline"] == NO_DEADLINE else a["deadline"]
+        db = inf if b["deadline"] == NO_DEADLINE else b["deadline"]
+        return da < db or (da == db and a["seq"] < b["seq"])
+
+    def _select_waiting(self) -> int:
+        """Returns -1 when no tenant may admit (all at their caps).
+        Pick order: each tenant's POLICY HEAD (no overtaking within a
+        tenant), tenants filtered by max_running, then the lowest-
+        virtual-service eligible tenant (ties: smaller tenant id).
+        With one uncapped tenant this degrades exactly to the pre-PR12
+        single-queue order."""
+        heads: dict = {}
+        for i, w in enumerate(self._waiting):
+            hi = heads.get(w["tenant"])
+            if hi is None or self._policy_better(w, self._waiting[hi]):
+                heads[w["tenant"]] = i
+        best, best_t = -1, 0
+        for tt, hi in heads.items():
+            t = self._tenants[tt]
+            if t[2] > 0 and t[3] + self._waiting[hi]["k"] > t[2]:
+                continue  # at its concurrency cap: its queue waits
+            if best < 0:
+                best, best_t = hi, tt
+                continue
+            va, vb = t[1], self._tenants[best_t][1]
+            if va < vb or (va == vb and tt < best_t):
+                best, best_t = hi, tt
         return best
 
     def admit(self, max_out: Optional[int] = None) -> List[Tuple[int, int]]:
@@ -421,6 +511,8 @@ class PyScheduler:
         out = []
         while self._waiting and self._free_slots:
             pick = self._select_waiting()
+            if pick < 0:
+                break  # every backlogged tenant is at its cap
             head = self._waiting[pick]
             k = head["k"]
             full_prompt = head["plen"] // self._ps
@@ -433,13 +525,33 @@ class PyScheduler:
             need_new = shared_new + k
             headroom = (self._watermark
                         if (self._running or out) else 0)
+            # Cached prefix pages this admission will ref (refs 0->k)
+            # leave the available pool when claimed — count them in
+            # the availability check or a tight pool allocates past
+            # empty (latent PR 8 bug; see the native twin).
+            refed_avail = 0
+            seen_pages = set()
+            for h in hashes[:cached]:
+                p = self._cache_map[h]
+                if p not in seen_pages:
+                    seen_pages.add(p)
+                    if self._cached_pages[p][1] == 0:
+                        refed_avail += 1
             if len(out) + k > max_out:
                 break
             if len(self._free_slots) < k:
                 break
-            if self._available() < need_new + headroom:
+            if self._available() < need_new + refed_avail + headroom:
                 break
             self._waiting.pop(pick)
+            # Weighted-fair accounting: the admitted tenant's virtual
+            # service advances by its normalized token cost; the
+            # global clock is the re-entry floor for idle tenants.
+            t = self._tenants[head["tenant"]]
+            t[1] += (head["plen"] + head["mnew"]) * k * self._VSCALE \
+                // t[0]
+            t[3] += k
+            self._vclock = t[1]
             cached_list = [self._cache_map[h] for h in hashes[:cached]]
             for p in cached_list:
                 self._ref_cached(p, k)
@@ -453,6 +565,7 @@ class PyScheduler:
                     "group": head["id"] if k > 1 else None,
                     "plen": head["plen"], "mnew": head["mnew"],
                     "prio": head["prio"], "deadline": head["deadline"],
+                    "tenant": head["tenant"],
                     "hashes": hashes, "seq": head["seq"]}
                 out.append((head["id"] + j, slot))
             if k > 1:
@@ -497,6 +610,7 @@ class PyScheduler:
 
     def finish(self, req_id: int) -> int:
         r = self._running.pop(req_id)
+        self._tenants[r["tenant"]][3] -= 1
         freed = 0
         for i in range(r["cached"]):
             self._unref_cached(r["pages"][i])
@@ -524,6 +638,7 @@ class PyScheduler:
         SOLO request, at its original arrival position for
         restart-by-recompute."""
         r = self._running.pop(req_id)
+        self._tenants[r["tenant"]][3] -= 1
         for i in range(r["cached"]):
             self._unref_cached(r["pages"][i])
         priv_start = r["cached"] + r["shared"]
@@ -539,7 +654,9 @@ class PyScheduler:
                 del self._groups[r["group"]]
         entry = {"id": req_id, "plen": r["plen"], "mnew": r["mnew"],
                  "k": 1, "prio": r["prio"], "deadline": r["deadline"],
+                 "tenant": r["tenant"],
                  "hashes": r["hashes"], "seq": r["seq"]}
+        self._catch_up(r["tenant"])
         pos = 0
         while (pos < len(self._waiting)
                and self._waiting[pos]["seq"] < r["seq"]):

@@ -43,6 +43,37 @@ if not TPU_RUN:
 
     force_cpu_platform(8)
     jax.config.update("jax_default_matmul_precision", "highest")
+    # Persistent XLA compile cache for the CPU suite (ISSUE 12): the
+    # tests build dozens of tiny engines whose jitted programs are
+    # BYTE-IDENTICAL across instances, but jax.jit's in-memory cache
+    # is per-closure so every engine recompiled them from scratch —
+    # measured ~45% of test_continuous.py's wall.  The disk cache is
+    # content-keyed (backend + jaxlib version + lowered HLO), so
+    # cross-run reuse is exactly as sound as jit's own cache;
+    # min_compile_time 0 because tiny-model programs all compile in
+    # well under the 5 s default threshold.  Opt out with
+    # ORION_TEST_NO_COMPILE_CACHE=1 (e.g. when timing compiles).
+    if os.environ.get("ORION_TEST_NO_COMPILE_CACHE") != "1":
+        jax.config.update("jax_compilation_cache_dir",
+                          "/tmp/orion-test-jax-cache")
+        jax.config.update(
+            "jax_persistent_cache_min_compile_time_secs", 0.0)
+        # Child processes too (multihost 2-process runs, pool-worker
+        # re-execs): they import jax fresh, so the env-var spelling
+        # reaches them where this process's jax.config cannot.
+        os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                              "/tmp/orion-test-jax-cache")
+        os.environ.setdefault(
+            "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0")
+    # Zero-egress box: tell the HF stack so instead of letting every
+    # cache-miss dataset/tokenizer lookup spin on connect timeouts —
+    # the two offline-error-path tests each burned ~20 s waiting for
+    # the network stack to give up on a box that HAS no network.
+    # Local-path fixture loads are unaffected (they never consult the
+    # hub), and the "not available offline" error contract is
+    # identical, just immediate.
+    os.environ.setdefault("HF_HUB_OFFLINE", "1")
+    os.environ.setdefault("HF_DATASETS_OFFLINE", "1")
 
 import pytest  # noqa: E402
 

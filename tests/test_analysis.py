@@ -614,6 +614,74 @@ FIXTURES = [
         "wire.py",
     ),
     (
+        # ISSUE 12: the gateway's SUBMIT/STREAM/CANCEL family is the
+        # SECOND frame family in the tree — the per-module scoping
+        # must keep the fully-handled pool chain clean while the
+        # gateway chain silently dropping one of ITS OWN frames (plus
+        # an imported HELLO) still fires.
+        "frame-exhaustive",
+        {
+            "wire.py": """
+            FRAME_HELLO = 1
+            FRAME_GOODBYE = 5
+
+            def pool_dispatch(kind, payload):
+                if kind == FRAME_HELLO:
+                    return payload
+                elif kind == FRAME_GOODBYE:
+                    return None
+                else:
+                    raise ValueError(f"unexpected frame {kind}")
+            """,
+            "gateway.py": """
+            from wire import FRAME_HELLO
+
+            FRAME_SUBMIT = 16
+            FRAME_STREAM = 17
+            FRAME_CANCEL = 18
+
+            def gw_dispatch(kind, payload):
+                if kind == FRAME_SUBMIT:
+                    return ("submit", payload)
+                elif kind == FRAME_STREAM:
+                    return ("stream", payload)
+                # CANCEL (and the imported HELLO) silently dropped
+            """,
+        },
+        {
+            "wire.py": """
+            FRAME_HELLO = 1
+            FRAME_GOODBYE = 5
+
+            def pool_dispatch(kind, payload):
+                if kind == FRAME_HELLO:
+                    return payload
+                elif kind == FRAME_GOODBYE:
+                    return None
+                else:
+                    raise ValueError(f"unexpected frame {kind}")
+            """,
+            "gateway.py": """
+            from wire import FRAME_HELLO
+
+            FRAME_SUBMIT = 16
+            FRAME_STREAM = 17
+            FRAME_CANCEL = 18
+
+            def gw_dispatch(kind, payload):
+                if kind == FRAME_HELLO:
+                    return ("hello", payload)
+                elif kind == FRAME_SUBMIT:
+                    return ("submit", payload)
+                elif kind == FRAME_STREAM:
+                    return ("stream", payload)
+                else:
+                    raise ValueError(f"unexpected frame {kind}")
+            """,
+        },
+        None,
+    ),
+    (
         # header format drifted from the registered PROTOCOL_VERSION
         # entry (the PR 9 v3-to-v4 rule, structurally checked)
         "frame-exhaustive",
@@ -1099,6 +1167,20 @@ def test_frame_exhaustive_accepts_loud_else_subset():
             raise ValueError(f"unexpected frame {kind}")
     """
     assert "frame-exhaustive" not in ids_of(run_on(src, "wire.py"))
+
+
+def test_gateway_frame_family_finding_scoped_to_gateway():
+    """The ISSUE 12 fixture's finding must land on gateway.py ONLY:
+    the pool module's fully-handled chain is judged against the
+    frames IT knows, not the gateway's family (the PR 11 scoping
+    logic, exercised by its first real in-tree consumer)."""
+    pos = next(p for (rid, p, _n, _path) in FIXTURES
+               if rid == "frame-exhaustive" and isinstance(p, dict))
+    hits = [f for f in run_on_files(pos)
+            if f.rule_id == "frame-exhaustive"]
+    assert hits
+    assert all(f.path.endswith("gateway.py") for f in hits), hits
+    assert any("FRAME_CANCEL" in f.message for f in hits)
 
 
 def test_frame_exhaustive_missing_history_table():

@@ -141,6 +141,43 @@ def test_metrics_writer_expands_histograms_and_counters(tmp_path):
     assert rec["profile_dir"] == "/tmp/prof"  # jsonl-only annotation
 
 
+def test_tenant_labelled_metrics_through_writer(tmp_path):
+    """ISSUE 12 satellite: per-tenant Counter/Histogram state flows
+    through RequestTelemetry as ``tenant_<name>_<metric>`` keys and
+    expands into _p50/_p95/_p99 columns via MetricsWriter.write with
+    NO writer plumbing — and reset() clears every tenant key."""
+    from orion_tpu.obs import RequestTelemetry
+
+    tel = RequestTelemetry()
+    for rid, tenant in ((1, "paid"), (2, "free"), (3, "pa id!")):
+        tel.mark(rid, "submit", tenant=tenant)
+        tel.mark(rid, "admit")
+        tel.mark(rid, "first_token")
+        tel.finish(rid, 4)
+    tel.record_shed("free")
+    hists = tel.histograms()
+    assert "tenant_paid_ttft_s" in hists
+    assert "tenant_pa_id__queue_wait_s" in hists  # label sanitized
+    with MetricsWriter(str(tmp_path), tensorboard=False) as w:
+        w.write(1, {**hists, **tel.counters()})
+    rec = json.loads(
+        open(os.path.join(str(tmp_path), "metrics.jsonl")).read())
+    for col in ("_p50", "_p95", "_p99", "_mean", "_count"):
+        assert f"tenant_paid_ttft_s{col}" in rec
+        assert f"tenant_free_queue_wait_s{col}" in rec
+    assert rec["tenant_paid_ttft_s_count"] == 1.0
+    assert rec["tenant_free_shed"] == 1.0
+    assert rec["tenant_paid_finished"] == 1.0
+    assert rec["requests_shed"] == 1.0
+    # the flat summary() carries the same keys (bench JSON shape)
+    summ = tel.summary()
+    assert summ["tenant_paid_ttft_s_p95"] > 0.0
+    tel.reset()
+    assert not any(k.startswith("tenant_")
+                   for k in {**tel.histograms(), **tel.counters()})
+    assert tel.summary()["requests_shed"] == 0.0
+
+
 def test_metrics_writer_lifecycle(tmp_path):
     w = MetricsWriter(str(tmp_path), tensorboard=False)
     w.write(0, {"a": 1})

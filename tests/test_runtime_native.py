@@ -208,16 +208,27 @@ def test_prefix_cache_lru_eviction(mk):
     assert s.free_pages == 0
 
 
-def _drive(a, b, seed, policy, max_k=4, n_ops=700):
+def _drive(a, b, seed, policy, max_k=4, n_ops=700, tenants=False):
     """Randomized step-for-step cross-check of the full PR 8 contract
     (solo + group adds with priorities/deadlines/prefix hashes, admit,
     extend, preempt, finish, clear_cache) extended with PR 10's
-    speculative extents: extends carry a random verify slack, and the
-    preempt op doubles as the rollback path (slack pages free with the
-    rest, requeue at arrival order)."""
+    speculative extents (extends carry a random verify slack, and the
+    preempt op doubles as the rollback path — slack pages free with
+    the rest, requeue at arrival order) and PR 12's multi-tenant QoS:
+    with ``tenants=True`` adds carry random tenant ids over weighted /
+    concurrency-capped envelopes, and a cancel op removes waiting
+    requests (after preempt for running ones)."""
     rng = random.Random(seed)
     hash_pool = [int(rng.getrandbits(62)) for _ in range(14)]
-    live, next_id = [], 0
+    n_tenants = 1
+    if tenants:
+        n_tenants = rng.randint(2, 4)
+        for t in range(n_tenants):
+            w = rng.randint(1, 8)
+            cap = rng.choice([0, 0, 1, 2, 3])
+            a.set_tenant(t, w, cap)
+            b.set_tenant(t, w, cap)
+    live, waiting_ids, next_id = [], [], 0
     for step in range(n_ops):
         op = rng.random()
         if op < 0.35:
@@ -227,12 +238,14 @@ def _drive(a, b, seed, policy, max_k=4, n_ops=700):
             nh = rng.randint(0, max(0, (plen - 1) // 4))
             hs = [rng.choice(hash_pool) for _ in range(nh)]
             k = rng.randint(1, max_k)
+            ten = rng.randrange(n_tenants + 1) if tenants else 0
             if k == 1:
-                a.add(next_id, plen, mnew, prio, dl, hs)
-                b.add(next_id, plen, mnew, prio, dl, hs)
+                a.add(next_id, plen, mnew, prio, dl, hs, ten)
+                b.add(next_id, plen, mnew, prio, dl, hs, ten)
+                waiting_ids.append(next_id)
             else:
-                a.add_group(next_id, plen, mnew, k, prio, dl, hs)
-                b.add_group(next_id, plen, mnew, k, prio, dl, hs)
+                a.add_group(next_id, plen, mnew, k, prio, dl, hs, ten)
+                b.add_group(next_id, plen, mnew, k, prio, dl, hs, ten)
             next_id += k
         elif op < 0.6:
             ra, rb = a.admit(), b.admit()
@@ -243,21 +256,28 @@ def _drive(a, b, seed, policy, max_k=4, n_ops=700):
                 assert a.cached_count(rid) == b.cached_count(rid)
                 assert a.shared_count(rid) == b.shared_count(rid)
                 live.append(rid)
+                if rid in waiting_ids:
+                    waiting_ids.remove(rid)
         elif op < 0.75 and live:
             rid = rng.choice(live)
             t = rng.randint(1, 70)
             slack = rng.choice([0, 0, 2, 4, 8])
             assert a.extend(rid, t, slack) == b.extend(rid, t, slack)
             assert a.pages(rid) == b.pages(rid)
-        elif op < 0.92 and live:
+        elif op < 0.9 and live:
             rid = live.pop(rng.randrange(len(live)))
             if rng.random() < 0.3:
                 a.preempt(rid)
                 b.preempt(rid)
+                waiting_ids.append(rid)
             else:
                 assert a.finish(rid) == b.finish(rid)
-        elif op < 0.95:
+        elif op < 0.93:
             assert a.clear_cache() == b.clear_cache()
+        elif op < 0.96 and tenants and waiting_ids:
+            rid = waiting_ids.pop(rng.randrange(len(waiting_ids)))
+            a.cancel(rid)
+            b.cancel(rid)
         assert (a.free_pages, a.available_pages, a.cached_total,
                 a.waiting, a.running) == \
                (b.free_pages, b.available_pages, b.cached_total,
@@ -266,7 +286,8 @@ def _drive(a, b, seed, policy, max_k=4, n_ops=700):
 
 def test_native_matches_python_randomized():
     """Seeded property test: the native and Python schedulers agree
-    STEP FOR STEP under the full recycle/prefix/policy contract."""
+    STEP FOR STEP under the full recycle/prefix/policy contract —
+    and again with PR 12 multi-tenant envelopes + cancels active."""
     if not native_available():
         pytest.skip("no toolchain")
     from orion_tpu.runtime.scheduler import _NativeScheduler
@@ -283,6 +304,97 @@ def test_native_matches_python_randomized():
         b = PyScheduler(n_pages, ps, slots, watermark=wm, policy=policy)
         assert type(a).__name__ != type(b).__name__
         _drive(a, b, seed=trial, policy=policy, max_k=min(4, slots))
+        a = _NativeScheduler(n_pages, ps, slots, watermark=wm,
+                             policy=policy)
+        b = PyScheduler(n_pages, ps, slots, watermark=wm, policy=policy)
+        _drive(a, b, seed=1000 + trial, policy=policy,
+               max_k=min(4, slots), tenants=True)
+
+
+@pytest.mark.parametrize("mk", [PyScheduler, Scheduler])
+def test_weighted_fair_tenant_admission(mk):
+    """PR 12 WFQ: under contention on one slot, a weight-3 tenant is
+    admitted ~3x the requests of a weight-1 tenant, and the integer
+    virtual-service order is identical in both implementations."""
+    s = mk(64, 4, 1, watermark=0, policy="fifo")
+    s.set_tenant(1, 3)
+    s.set_tenant(2, 1)
+    for i in range(8):
+        s.add(100 + i, 4, 4, tenant=1)
+        s.add(200 + i, 4, 4, tenant=2)
+    order = []
+    for _ in range(16):
+        adm = s.admit()
+        assert len(adm) == 1
+        order.append(adm[0][0])
+        s.finish(adm[0][0])
+    # first 8 admissions: the weight-3 tenant gets ~3/4 of them
+    share = sum(1 for r in order[:8] if r < 200)
+    assert share == 6, order
+    # everything is served eventually (WFQ starves nobody)
+    assert sorted(order) == sorted([100 + i for i in range(8)]
+                                   + [200 + i for i in range(8)])
+
+
+@pytest.mark.parametrize("mk", [PyScheduler, Scheduler])
+def test_tenant_max_running_cap(mk):
+    """Reserved capacity: a tenant capped at 1 running request can
+    never occupy more than 1 slot, while uncapped traffic fills the
+    rest; its queue resumes when its own work finishes."""
+    s = mk(64, 4, 4, watermark=0, policy="fifo")
+    s.set_tenant(1, 1, 1)
+    for i in range(3):
+        s.add(10 + i, 4, 4, tenant=1)
+    for i in range(2):
+        s.add(20 + i, 4, 4, tenant=0)
+    adm = [r for r, _ in s.admit()]
+    assert sum(1 for r in adm if r >= 20) == 2
+    assert sum(1 for r in adm if r < 20) == 1  # capped at 1
+    assert s.admit() == []                     # still capped
+    first = min(r for r in adm if r < 20)
+    s.finish(first)
+    assert [r for r, _ in s.admit()] == [11]   # its queue resumes
+
+
+@pytest.mark.parametrize("mk", [PyScheduler, Scheduler])
+def test_cancel_removes_waiting(mk):
+    s = mk(16, 4, 1, watermark=0)
+    s.add(1, 4, 4)
+    s.add(2, 4, 4)
+    assert [r for r, _ in s.admit()] == [1]
+    s.cancel(2)
+    assert s.waiting == 0
+    with pytest.raises(KeyError):
+        s.cancel(2)
+    with pytest.raises(KeyError):
+        s.cancel(1)  # running, not waiting
+    s.finish(1)
+    assert s.admit() == []
+
+
+@pytest.mark.parametrize("mk", [PyScheduler, Scheduler])
+def test_admission_counts_refed_cache_pages(mk):
+    """Latent PR 8 bug (found by ASan under the PR 12 randomized
+    drive): admission counted an unreferenced cached page BOTH as
+    available-to-allocate and as the shared prefix it was about to
+    pin, so a tight pool allocated past empty — native UB, Python
+    IndexError.  The availability check must cover the about-to-be-
+    refed pages; the request waits instead."""
+    s = mk(5, 4, 2, watermark=0)
+    s.add(1, 9, 3, prefix_hashes=(7, 8))
+    assert [r for r, _ in s.admit()] == [1]    # 3 pages
+    s.add(2, 5, 30)
+    assert [r for r, _ in s.admit()] == [2]    # 2 pages -> free 0
+    s.finish(1)                                # 2 cached, 1 freed
+    assert s.extend(2, 12) == 1                # free 0, avail 2
+    assert s.free_pages == 0 and s.available_pages == 2
+    # B shares both cached pages and needs 1 fresh page: the old check
+    # saw available=2 >= 1 and crashed allocating from an empty pool.
+    s.add(3, 9, 3, prefix_hashes=(7, 8))
+    assert s.admit() == []                     # waits, no crash
+    s.finish(2)
+    assert [r for r, _ in s.admit()] == [3]
+    assert s.cached_count(3) == 2
 
 
 def test_bad_params_and_unknown_ids():

@@ -1,0 +1,353 @@
+"""Token streaming + multi-tenant QoS on the continuous engine
+(ISSUE 12 tentpole (a)/(c)).
+
+Streaming changes only what the host FETCHES per wave — never what
+the device computes — so the streamed token sequence must be
+BIT-EXACT against ``generate()`` for the same seed, at temperature 0
+and 1, and under every serving composition (prefix cache + chunked
+prefill, speculative decoding).  QoS gates shed with the typed
+:class:`EngineOverloaded` (queue depth + retry-after) and leave zero
+engine residue.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from orion_tpu.config import ModelConfig, RolloutConfig
+from orion_tpu.models import Transformer, init_params
+from orion_tpu.rollout.continuous import (ContinuousBatchingEngine,
+                                          EngineOverloaded)
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = ModelConfig.tiny(dtype="float32")
+    model = Transformer(cfg)
+    params = init_params(model, jax.random.key(0), cfg)
+    return cfg, model, params
+
+
+def _mk(model, cfg, params, **kw):
+    base = dict(max_prompt_len=32, max_new_tokens=10, temperature=0.0,
+                page_size=4, max_batch_size=4)
+    base.update(kw)
+    eng = ContinuousBatchingEngine(model, cfg, RolloutConfig(**base),
+                                   eos_token_id=None, segment_len=4)
+    eng.load_weights(params)
+    return eng
+
+
+def _prompts(cfg, seed=0, n=6):
+    rng = np.random.RandomState(seed)
+    return [rng.randint(1, cfg.vocab_size, rng.randint(4, 30))
+            .astype(np.int32) for _ in range(n)]
+
+
+def _stream_all(eng, prompts, key, **submit_kw):
+    """Submit every prompt with stream=True and drain via poll();
+    returns ({rid: concatenated streamed tokens}, {rid: completed})."""
+    eng.reset_rng(key)
+    for i, p in enumerate(prompts):
+        eng.submit(i, p, stream=True, **submit_kw)
+    chunks = {i: [] for i in range(len(prompts))}
+    fin = {}
+    waves = 0
+    while eng.pending:
+        eng.step()
+        for i in list(chunks):
+            if i in fin:
+                continue
+            try:
+                ch = eng.poll(i)
+            except KeyError:
+                continue
+            if ch is None:
+                continue
+            if ch.restarted:
+                chunks[i] = []  # restart-by-recompute voids the prefix
+            chunks[i].append(ch.tokens)
+            if ch.done:
+                fin[i] = ch.completed
+        waves += 1
+        assert waves < 300
+    streamed = {i: (np.concatenate([c for c in chunks[i]])
+                    if chunks[i] else np.empty(0, np.int32))
+                for i in chunks}
+    return streamed, fin
+
+
+@pytest.mark.parametrize("temperature", [0.0, 1.0])
+def test_streamed_tokens_bit_exact_vs_generate(setup, temperature):
+    """The acceptance bar: streamed chunks concatenate to EXACTLY the
+    generate() token sequence for the same seed, temp 0 and temp 1."""
+    cfg, model, params = setup
+    prompts = _prompts(cfg, seed=1)
+    reqs = [(i, p) for i, p in enumerate(prompts)]
+    base = {r.req_id: r for r in
+            _mk(model, cfg, params, temperature=temperature,
+                prefix_cache=False).generate(reqs, jax.random.key(7),
+                                             params)}
+    svc = _mk(model, cfg, params, temperature=temperature,
+              prefix_cache=False)
+    streamed, fin = _stream_all(svc, prompts, jax.random.key(7))
+    assert sorted(fin) == sorted(base)
+    for i in base:
+        np.testing.assert_array_equal(streamed[i], base[i].tokens,
+                                      err_msg=f"req {i}")
+        np.testing.assert_array_equal(fin[i].tokens, base[i].tokens)
+        np.testing.assert_array_equal(fin[i].logprobs, base[i].logprobs)
+
+
+def test_streamed_bit_exact_under_cache_and_chunked_prefill(setup):
+    """Composition: prefix cache + chunked prefill active, temp 1 —
+    the streamed sequence still equals generate() bit for bit
+    (including the second pass where the cache actually hits)."""
+    cfg, model, params = setup
+    rng = np.random.RandomState(3)
+    pref = rng.randint(1, cfg.vocab_size, 12).astype(np.int32)
+    prompts = [np.concatenate(
+        [pref, rng.randint(1, cfg.vocab_size, n).astype(np.int32)])
+        for n in (4, 9, 2, 14)]
+    kw = dict(temperature=1.0, prefix_cache=True,
+              chunked_prefill_tokens=8)
+    gen_eng = _mk(model, cfg, params, **kw)
+    svc = _mk(model, cfg, params, **kw)
+    for key in (jax.random.key(5), jax.random.key(6)):  # pass 2 = hits
+        base = {r.req_id: r for r in gen_eng.generate(
+            [(i, p) for i, p in enumerate(prompts)], key, params)}
+        streamed, fin = _stream_all(svc, prompts, key)
+        for i in base:
+            np.testing.assert_array_equal(streamed[i], base[i].tokens,
+                                          err_msg=f"req {i}")
+            np.testing.assert_array_equal(fin[i].logprobs,
+                                          base[i].logprobs)
+    assert svc.sched.cached_total > 0  # the cache actually engaged
+
+
+@pytest.mark.parametrize("temperature", [0.0, 1.0])
+def test_streamed_bit_exact_under_speculative(setup, temperature):
+    """Composition: speculative decoding v2 (per-slot draft/verify)
+    with streaming — cyclic prompts so drafts actually accept.  At
+    temp 1 the delta-draft path consumes the same RNG stream either
+    way, so streamed == generate() stays bitwise."""
+    cfg, model, params = setup
+    rng = np.random.RandomState(4)
+    prompts = [np.tile(rng.randint(1, cfg.vocab_size, 4)
+                       .astype(np.int32), 5) for _ in range(4)]
+    kw = dict(temperature=temperature, prefix_cache=False,
+              speculative_k=2, max_new_tokens=12)
+    base = {r.req_id: r for r in _mk(model, cfg, params, **kw).generate(
+        [(i, p) for i, p in enumerate(prompts)], jax.random.key(9),
+        params)}
+    svc = _mk(model, cfg, params, **kw)
+    streamed, fin = _stream_all(svc, prompts, jax.random.key(9))
+    for i in base:
+        np.testing.assert_array_equal(streamed[i], base[i].tokens,
+                                      err_msg=f"req {i}")
+
+
+def test_streaming_callback_surface_and_incremental(setup):
+    """on_tokens pushes chunks from inside step(): more than one chunk
+    per long request (budget >> segment_len — delivery is incremental,
+    not finish-at-end), the first chunk lands while the request is
+    still decoding, the concatenation equals the completed tokens, and
+    done arrives exactly once (callback streams never buffer for
+    poll)."""
+    cfg, model, params = setup
+    eng = _mk(model, cfg, params, max_new_tokens=16, prefix_cache=False)
+    eng.reset_rng(jax.random.key(2))
+    got, dones, early = [], [], []
+
+    def cb(chunk):
+        if chunk.tokens.size:
+            got.append(chunk.tokens)
+            if not chunk.done and eng.pending:
+                early.append(True)
+        if chunk.done:
+            dones.append(chunk.completed)
+
+    eng.submit(0, _prompts(cfg, seed=5, n=1)[0], budget=16, stream=True,
+               on_tokens=cb)
+    waves = 0
+    while eng.pending:
+        eng.step()
+        waves += 1
+        assert waves < 100
+    assert len(dones) == 1
+    assert len(got) >= 2, "streaming delivered everything at once"
+    assert early, "no chunk arrived before the request finished"
+    np.testing.assert_array_equal(np.concatenate(got), dones[0].tokens)
+    with pytest.raises(KeyError):
+        eng.poll(0)
+
+
+def test_streaming_restart_on_preemption(setup):
+    """A preempted streaming request restarts its stream: the client
+    sees restarted=True, discards the prefix, and the final
+    concatenation still equals the ample-pool greedy completion."""
+    cfg, model, params = setup
+    rng = np.random.RandomState(7)
+    prompts = [rng.randint(1, cfg.vocab_size, 9).astype(np.int32)
+               for _ in range(4)]
+    ample = _mk(model, cfg, params, prefix_cache=False,
+                max_prompt_len=16, max_new_tokens=8)
+    base = {r.req_id: r for r in ample.generate(
+        [(i, p) for i, p in enumerate(prompts)], jax.random.key(3),
+        params)}
+    tight = _mk(model, cfg, params, prefix_cache=False, num_pages=12,
+                page_watermark=0, max_prompt_len=16, max_new_tokens=8)
+    streamed, fin = _stream_all(tight, prompts, jax.random.key(3))
+    assert tight.preemptions > 0
+    for i in base:
+        np.testing.assert_array_equal(streamed[i], base[i].tokens,
+                                      err_msg=f"req {i}")
+
+
+def test_cancel_waiting_and_decoding(setup):
+    """cancel() dequeues a waiting request immediately and evicts a
+    decoding one through the preemption machinery; the rest of the
+    traffic is untouched and the pool drains clean."""
+    cfg, model, params = setup
+    eng = _mk(model, cfg, params, prefix_cache=False, max_batch_size=2,
+              max_new_tokens=8)
+    eng.reset_rng(jax.random.key(0))
+    prompts = _prompts(cfg, seed=8, n=4)
+    for i, p in enumerate(prompts):
+        eng.submit(i, p, budget=8)
+    eng.step()           # 0 and 1 now decoding; 2 and 3 waiting
+    assert eng.cancel(3) is True      # waiting: dequeued now
+    assert eng.cancel(0) is True      # decoding: evicted now
+    assert eng.preemptions == 0       # cancel is not a recompute
+    done = set()
+    waves = 0
+    while eng.pending:
+        done.update(r.req_id for r in eng.step())
+        waves += 1
+        assert waves < 100
+    assert done == {1, 2}
+    assert eng.cancelled_requests == 2
+    assert eng.sched.available_pages == eng.num_pages
+    with pytest.raises(KeyError):
+        eng.cancel(0)  # unknown now
+
+
+def test_cancel_mid_prefill_deferred(setup):
+    """A cancel landing while the request is mid-chunked-prefill is
+    deferred one wave (its pages are being written by an in-flight
+    program) and applied at the next step boundary."""
+    cfg, model, params = setup
+    eng = _mk(model, cfg, params, prefix_cache=False,
+              chunked_prefill_tokens=8, max_new_tokens=8)
+    eng.reset_rng(jax.random.key(0))
+    long_prompt = np.arange(1, 31, dtype=np.int32)  # 30 > chunk of 8
+    eng.submit(0, long_prompt, budget=8)
+    eng.step()  # first intermediate chunk: request is mid-prefill
+    assert eng.cancel(0) is False     # deferred
+    waves = 0
+    while eng.pending:
+        assert not eng.step()         # never completes: it is aborted
+        waves += 1
+        assert waves < 100
+    assert eng.cancelled_requests == 1
+    assert eng.sched.available_pages == eng.num_pages
+
+
+# -- QoS gates: typed backpressure (satellite 1, in-process path) ------
+
+def test_overload_global_watermark(setup):
+    cfg, model, params = setup
+    eng = _mk(model, cfg, params, max_queued_requests=2,
+              max_batch_size=1)
+    eng.reset_rng(jax.random.key(0))
+    prompts = _prompts(cfg, seed=9, n=4)
+    eng.submit(0, prompts[0])
+    eng.step()                 # 0 admitted; queue empty again
+    eng.submit(1, prompts[1])
+    eng.submit(2, prompts[2])  # 2 waiting = watermark
+    with pytest.raises(EngineOverloaded) as ei:
+        eng.submit(3, prompts[3])
+    assert ei.value.queue_depth == 2
+    assert ei.value.retry_after > 0
+    assert eng.shed_requests == 1
+    # zero residue: the shed id is reusable once the queue drains
+    while eng.pending:
+        eng.step()
+    eng.submit(3, prompts[3])
+    while eng.pending:
+        eng.step()
+
+
+def test_overload_tenant_cap_and_rate_limit(setup):
+    cfg, model, params = setup
+    eng = _mk(model, cfg, params, max_batch_size=1)
+    eng.reset_rng(jax.random.key(0))
+    eng.configure_tenant("free", weight=1, max_queued=1)
+    eng.configure_tenant("drip", rate_limit=0.001, burst=1.0)
+    prompts = _prompts(cfg, seed=10, n=4)
+    eng.submit(0, prompts[0], tenant="free")
+    with pytest.raises(EngineOverloaded) as ei:
+        eng.submit(1, prompts[1], tenant="free")
+    assert ei.value.tenant == "free"
+    # rate limit: first submit drains the burst, second is shed with a
+    # retry hint ~ the bucket refill time
+    eng.submit(2, prompts[2], tenant="drip")
+    with pytest.raises(EngineOverloaded) as ei:
+        eng.submit(3, prompts[3], tenant="drip")
+    assert ei.value.retry_after > 1.0
+    stats = eng.server_stats()
+    assert stats["shed_requests"] == 2.0
+    assert stats["tenant_free_shed"] == 1.0
+    assert stats["tenant_drip_shed"] == 1.0
+    while eng.pending:
+        eng.step()
+
+
+def test_tenant_slo_stats_and_reset(setup):
+    """Per-tenant TTFT/queue-wait percentiles ride server_stats() as
+    tenant_<name>_* keys; reset_server_stats() clears ALL tenant
+    state (satellite 3, engine side)."""
+    cfg, model, params = setup
+    eng = _mk(model, cfg, params, prefix_cache=False)
+    eng.reset_rng(jax.random.key(0))
+    prompts = _prompts(cfg, seed=11, n=4)
+    for i, p in enumerate(prompts):
+        eng.submit(i, p, tenant="paid" if i % 2 == 0 else "free")
+    while eng.pending:
+        eng.step()
+    stats = eng.server_stats()
+    for ten in ("paid", "free"):
+        assert stats[f"tenant_{ten}_ttft_s_count"] == 2.0
+        assert stats[f"tenant_{ten}_queue_wait_s_p95"] >= 0.0
+        assert stats[f"tenant_{ten}_ttft_s_p95"] > 0.0
+        assert stats[f"tenant_{ten}_finished"] == 2.0
+    eng.reset_server_stats()
+    stats = eng.server_stats()
+    assert not any(k.startswith("tenant_") for k in stats), \
+        "reset_server_stats must clear per-tenant state"
+
+
+def test_weighted_fair_admission_order(setup):
+    """Engine-level WFQ: a weight-3 tenant is admitted ~3x the
+    requests of a weight-1 tenant under contention (single slot, all
+    requests submitted up front)."""
+    cfg, model, params = setup
+    eng = _mk(model, cfg, params, prefix_cache=False, max_batch_size=1,
+              max_new_tokens=4)
+    eng.reset_rng(jax.random.key(0))
+    eng.configure_tenant("gold", weight=3)
+    eng.configure_tenant("econ", weight=1)
+    rng = np.random.RandomState(12)
+    for i in range(6):
+        p = rng.randint(1, cfg.vocab_size, 6).astype(np.int32)
+        eng.submit(i, p, budget=4, tenant="gold")
+        eng.submit(100 + i, p, budget=4, tenant="econ")
+    order = []
+    waves = 0
+    while eng.pending:
+        order.extend(r.req_id for r in eng.step())
+        waves += 1
+        assert waves < 300
+    first8 = order[:8]
+    gold_share = sum(1 for r in first8 if r < 100)
+    assert gold_share >= 5, (first8, "weight-3 tenant under-served")
