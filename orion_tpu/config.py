@@ -554,6 +554,134 @@ class ResilienceConfig:
 
 
 @dataclass
+class Setpoint:
+    """One controlled signal's operating band for the SLO autopilot
+    (orion_tpu.orchestration.autopilot).
+
+    ``target`` is the value the controller steers toward (recorded as
+    the error term in every decision), ``ceiling`` the escalate-above
+    threshold and ``floor`` the relax-below threshold.  The floor <
+    ceiling gap IS the hysteresis band — a signal oscillating inside it
+    triggers nothing.  ``ceiling <= 0`` disables the signal entirely
+    (the controller never reads it), which is how deterministic tests
+    switch off wall-clock signals like TTFT p95.
+    """
+
+    target: float = 0.0
+    floor: float = 0.0
+    ceiling: float = 0.0
+
+    def __post_init__(self) -> None:
+        if self.target < 0 or self.floor < 0:
+            raise ValueError(
+                f"setpoint target/floor must be >= 0, got "
+                f"target={self.target} floor={self.floor}")
+        if self.ceiling > 0 and self.floor > self.ceiling:
+            raise ValueError(
+                f"setpoint floor {self.floor} above ceiling "
+                f"{self.ceiling}: the hysteresis band would be empty "
+                "and the controller would flap")
+
+
+@dataclass
+class ControllerConfig:
+    """Closed-loop SLO autopilot (orion_tpu.orchestration.autopilot).
+
+    The ROADMAP refactor: the engine's scattered tuning knobs become
+    typed setpoints in ONE place.  Signals are read from
+    ``server_stats()`` / scheduler gauges / pool recovery counters;
+    actuators are the machinery PRs 6/10/12 already built
+    (``apply_setpoints`` on the continuous engine, ``configure_tenant``
+    envelopes, the launch.py worker-spawn path).  Off by default — the
+    controller costs nothing unless armed.
+    """
+
+    enabled: bool = False
+    # Wall-clock tick cadence (s) when a pump loop drives the
+    # controller (gateway / orchestrators).  Deterministic tests call
+    # tick() directly and never consult this.
+    tick_interval: float = 0.25
+    # Hysteresis: a signal must sit past its ceiling (or under its
+    # floor) for this many CONSECUTIVE ticks before the ladder moves...
+    hold_ticks: int = 3
+    # ...and after any ladder transition the controller holds position
+    # for this many ticks regardless of signals (anti-flap cooldown).
+    cooldown_ticks: int = 4
+    # -- controlled signals --------------------------------------------
+    # Unadmitted (waiting) requests in the engine scheduler.
+    queue_depth: Setpoint = field(default_factory=lambda: Setpoint(
+        target=2.0, floor=1.0, ceiling=8.0))
+    # Fraction of KV pages in use (1 - available/total).
+    page_occupancy: Setpoint = field(default_factory=lambda: Setpoint(
+        target=0.70, floor=0.50, ceiling=0.92))
+    # Streaming TTFT p95 seconds from telemetry — a wall-clock signal,
+    # disabled by default (ceiling 0) so seeded runs stay bit-exact;
+    # real deployments arm it alongside the gauges.
+    ttft: Setpoint = field(default_factory=Setpoint)
+    # Speculative acceptance EMA (tokens/verify step): below floor the
+    # controller raises spec_breakeven to tuned_spec_breakeven (the
+    # verify chunk is not paying for itself), above ceiling it restores
+    # the baseline.  ceiling 0 disables.
+    spec_accept: Setpoint = field(default_factory=Setpoint)
+    # Pool capacity: target = desired live workers (spawn below it),
+    # ceiling = retire-above bound, floor = never retire below.
+    # target 0 disables the capacity loop.
+    workers: Setpoint = field(default_factory=Setpoint)
+    # -- rung 1 (tuned) actuator values --------------------------------
+    # Each 0 leaves that knob untouched at the tuned rung.
+    tuned_spec_breakeven: float = 0.0   # >= 1.0 when set
+    tuned_chunk_tokens: int = 0         # chunked_prefill_tokens under load
+    tuned_watermark_delta: int = 0      # pages added to page_watermark
+    # -- rung 2 (shed) actuator values ---------------------------------
+    # QoS envelope clamped onto every non-protected tenant while the
+    # shed rung holds (original envelopes restored on relax).
+    shed_max_running: int = 1
+    shed_max_queued: int = 1
+    shed_rate_limit: float = 0.0        # 0 = leave the tenant's rate alone
+    # Tenants the shed rung must never tighten (the paid tier).
+    protect_tenants: tuple = ("paid",)
+
+    def __post_init__(self) -> None:
+        if isinstance(self.protect_tenants, str):
+            self.protect_tenants = tuple(
+                t.strip() for t in self.protect_tenants.split(",")
+                if t.strip())
+        self.protect_tenants = tuple(str(t) for t in self.protect_tenants)
+        if self.tick_interval <= 0:
+            raise ValueError(
+                f"controller.tick_interval must be > 0, got "
+                f"{self.tick_interval}")
+        if self.hold_ticks < 1:
+            raise ValueError(
+                f"controller.hold_ticks must be >= 1, got "
+                f"{self.hold_ticks}")
+        if self.cooldown_ticks < 0:
+            raise ValueError(
+                f"controller.cooldown_ticks must be >= 0, got "
+                f"{self.cooldown_ticks}")
+        if self.tuned_spec_breakeven and self.tuned_spec_breakeven < 1.0:
+            raise ValueError(
+                f"controller.tuned_spec_breakeven must be >= 1.0 "
+                f"(0 leaves spec_breakeven alone), got "
+                f"{self.tuned_spec_breakeven}")
+        if self.tuned_chunk_tokens < 0 or self.tuned_watermark_delta < 0:
+            raise ValueError(
+                "controller.tuned_chunk_tokens/tuned_watermark_delta "
+                f"must be >= 0, got {self.tuned_chunk_tokens}/"
+                f"{self.tuned_watermark_delta}")
+        if self.shed_max_running < 1 or self.shed_max_queued < 1:
+            raise ValueError(
+                "controller.shed_max_running/shed_max_queued must be "
+                ">= 1 (0 would mean UNLIMITED to the engine — the shed "
+                f"rung would relax QoS, not tighten it), got "
+                f"{self.shed_max_running}/{self.shed_max_queued}")
+        if self.shed_rate_limit < 0:
+            raise ValueError(
+                f"controller.shed_rate_limit must be >= 0 (0 leaves "
+                f"tenant rates alone), got {self.shed_rate_limit}")
+
+
+@dataclass
 class TrainConfig:
     """Common trainer settings shared by all algorithms."""
 
@@ -626,6 +754,10 @@ class TrainConfig:
     # Observability (orion_tpu.obs): span tracing, Perfetto export,
     # and the crash flight recorder.
     obs: ObsConfig = field(default_factory=ObsConfig)
+    # Closed-loop SLO autopilot (orion_tpu.orchestration.autopilot):
+    # typed setpoints + the load-shed rung of the degradation ladder.
+    controller: ControllerConfig = field(
+        default_factory=ControllerConfig)
 
 
 @dataclass

@@ -307,7 +307,16 @@ def run_serve(cfg, port: int = 0, tenant_spec: Optional[str] = None,
     engine.load_weights(params)
     engine.reset_rng(jax.random.key(cfg.seed + 1))
     tenants = parse_tenant_spec(tenant_spec) if tenant_spec else None
-    gw = ServingGateway(engine, port=port, host=host, tenants=tenants)
+    autopilot = None
+    if cfg.controller.enabled:
+        # Closed-loop SLO autopilot (PR 13): the gateway pump drives
+        # its ticks, so the one thread that owns the engine also owns
+        # every setpoint/QoS actuation.
+        from orion_tpu.orchestration.autopilot import SLOAutopilot
+
+        autopilot = SLOAutopilot(cfg.controller, engine=engine)
+    gw = ServingGateway(engine, port=port, host=host, tenants=tenants,
+                        autopilot=autopilot)
     handler = None
     if threading.current_thread() is threading.main_thread():
         handler = install_handler()
@@ -340,9 +349,15 @@ def spawn_pool_workers(algo: str, argv: list, port: int, n: int) -> list:
     each worker its own host."""
     import subprocess
 
+    from orion_tpu.resilience import fault_point
+
     worker_platform = os.environ.get("ORION_POOL_WORKER_PLATFORM")
     procs = []
     for rank in range(n):
+        # Chaos boundary: process spawn can fail in the wild (fork
+        # limits, exec errors) and is also how the SLO autopilot's
+        # respawn path gets exercised under an armed FaultPlan.
+        fault_point("worker.spawn")
         env = dict(os.environ)
         env["ORION_POOL_WORKER_PORT"] = str(port)
         env["ORION_POOL_WORKER_RANK"] = str(rank)
@@ -511,6 +526,14 @@ def main(argv: Optional[list] = None) -> Any:
             orch = PoolOrchestrator(trainer)  # pool built from config
             procs = spawn_pool_workers(algo, raw_argv, orch.pool.port,
                                        cfg.resilience.pool_size)
+            if orch.autopilot is not None:
+                # Elastic respawn actuator: one more worker process
+                # through the exact spawn path used at startup.  The
+                # Popen handle joins the reap list so the launcher's
+                # exit discipline covers controller-spawned workers
+                # too.
+                orch.autopilot.spawn_fn = lambda: procs.extend(
+                    spawn_pool_workers(algo, raw_argv, orch.pool.port, 1))
             try:
                 return orch.train(prompt_iter, eval_iter=eval_iter)
             finally:

@@ -3,6 +3,10 @@ from orion_tpu.orchestration.async_orchestrator import (  # noqa: F401
     PoolOrchestrator,
     split_devices,
 )
+from orion_tpu.orchestration.autopilot import (  # noqa: F401
+    SignalReader,
+    SLOAutopilot,
+)
 from orion_tpu.orchestration.remote import (  # noqa: F401
     PoolWorkerClient,
     ProtocolError,

@@ -240,6 +240,12 @@ class AsyncOrchestrator:
         self._incarnation = 0    # rollout-worker generation counter
         self._abandoned: list = []  # stalled threads we cannot join
         self._produced = 0       # batches enqueued by the current run
+        # Attachment point for an SLO autopilot (PR 13).  Not built
+        # here: the rollout thread owns the engine, so only a caller
+        # that arranges safe actuation (or wants counters-only
+        # observation) attaches one; its counters then ride every
+        # metrics row via _recovery_stats.
+        self.autopilot = None
         self._broadcast_weights()  # version 0: initial policy
         self._rng = jax.random.key(trainer.cfg.seed + 7919)
 
@@ -740,12 +746,15 @@ class AsyncOrchestrator:
         """Recovery counters tagged onto every metrics row — restart/
         degrade/quarantine events must be visible in the stream, not
         just in logs."""
-        return {
+        out = {
             "rollout_restarts": float(self.recovery["rollout_restarts"]),
             "quarantined_batches": float(
                 self.recovery["quarantined_batches"]),
             "degraded_sync_rollout": 1.0 if degraded else 0.0,
         }
+        if self.autopilot is not None:
+            out.update(self.autopilot.counters())
+        return out
 
 
 class PoolOrchestrator:
@@ -815,6 +824,17 @@ class PoolOrchestrator:
         self.events: list = []   # learner-side decisions, in order
         self.recovery = {"quarantined_batches": 0,
                          "degraded_iterations": 0}
+        # SLO autopilot in its pool-learner shape (PR 13): no serving
+        # engine on this side of the process boundary, so the ladder
+        # stays parked and only the elastic-capacity loop acts —
+        # launch.py (or a test) provides spawn_fn/retire_fn and the
+        # workers setpoint drives respawn of dead pool workers.
+        self.autopilot = None
+        ctrl = getattr(trainer.cfg, "controller", None)
+        if ctrl is not None and ctrl.enabled:
+            from orion_tpu.orchestration.autopilot import SLOAutopilot
+
+            self.autopilot = SLOAutopilot(ctrl, engine=None, pool=pool)
         self._version = 0
         self._rng = jax.random.key(trainer.cfg.seed + 7919)
         self._broadcast()  # version 0: initial policy for every joiner
@@ -861,6 +881,12 @@ class PoolOrchestrator:
         empty_since = None
         while True:
             self.pool.reap_stalled()
+            if self.autopilot is not None:
+                # The wait loop is exactly where elastic capacity
+                # matters: a worker died, the survivors (or an empty
+                # pool) are absorbing — the capacity loop respawns
+                # through spawn_fn while the learner waits.
+                self.autopilot.maybe_tick()
             got = self.pool.next_item(timeout=0.1)
             if got is not None:
                 member, frame = got
@@ -1118,7 +1144,7 @@ class PoolOrchestrator:
         worker death must be visible in the stream, not just in
         logs."""
         pr = self.pool.recovery
-        return {
+        out = {
             "worker_deaths": float(pr["worker_deaths"]),
             "worker_leaves": float(pr["worker_leaves"]),
             "worker_joins": float(pr["worker_joins"]),
@@ -1127,3 +1153,6 @@ class PoolOrchestrator:
                 self.recovery["quarantined_batches"]),
             "degraded_sync_rollout": 1.0 if degraded else 0.0,
         }
+        if self.autopilot is not None:
+            out.update(self.autopilot.counters())
+        return out

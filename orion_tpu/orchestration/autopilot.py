@@ -1,0 +1,508 @@
+"""Closed-loop SLO autopilot: elastic pool autoscaling, adaptive
+setpoints, and an explicit load-shed rung on the degradation ladder
+(PR 13; ROADMAP "scattered knobs → typed setpoints" refactor).
+
+PRs 6/10/12 built the *mechanisms* — paged admission watermarks,
+chunked prefill, speculative breakeven, per-tenant QoS envelopes, the
+pool worker spawn path — but every knob was a static config value
+picked before the run.  This module closes the loop: a deterministic
+controller reads the signals the serving stack already emits
+(scheduler gauges, ``server_stats()`` telemetry, pool recovery
+counters) and steers those same mechanisms online so p95 holds through
+load ramps and worker deaths instead of being a launch-time guess.
+
+Design rules, in order of precedence:
+
+1. **Deterministic.**  A decision is a pure function of the gauges and
+   the controller's own state; no wall clock enters ``tick()``.  The
+   pump layers (gateway step, orchestrator wait loop, the optional
+   runner thread) own cadence via ``maybe_tick``; seeded tests call
+   ``tick()`` directly and the decision log replays bit-identically
+   under the same (trace, FaultPlan, seed).
+2. **Hysteresis, never flap.**  The ladder moves one rung at a time,
+   only after a signal sits past its band edge for ``hold_ticks``
+   consecutive ticks, and never within ``cooldown_ticks`` of the last
+   transition.  The ``Setpoint`` floor < ceiling gap is the dead band.
+3. **Shed before quality degrades.**  The new rung tightens
+   non-protected tenants' QoS envelopes (``configure_tenant``) so the
+   paid tier keeps its latency while best-effort load absorbs the
+   shortfall — and restores the exact prior envelopes on relax.
+4. **Observable.**  Every decision is a span, every ladder transition
+   a flight-recorder dump, every action a counter
+   (``autopilot_spawns`` / ``autopilot_sheds`` /
+   ``autopilot_setpoint_changes`` ...) merged into the metrics rows.
+5. **Fail open.**  ``fault_point("controller.decide")`` is inside the
+   tick's try: an injected (or real) controller crash increments
+   ``autopilot_decide_errors`` and skips the tick — the control loop
+   must never take serving down with it.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+from orion_tpu import obs
+from orion_tpu.config import ControllerConfig, Setpoint
+from orion_tpu.resilience import fault_point
+
+_LOG = logging.getLogger("orion.autopilot")
+
+#: Ladder rungs, mild to drastic.  Index order IS escalation order.
+RUNGS: Tuple[str, ...] = ("normal", "tuned", "shed")
+
+
+class SignalReader:
+    """Reset-robust view over the serving stack's signals.
+
+    Gauges (scheduler waiting depth, page occupancy, live worker
+    count, the spec-acceptance EMA) are read directly — they are
+    instantaneous and survive nothing, so nothing to protect.
+    Cumulative counters (``shed_requests`` and the per-tenant
+    ``tenant_<t>_requests_shed`` SLO counters) are carried forward
+    across ``reset_server_stats()``: a bench window reset mid-flight
+    must not make the controller believe shedding stopped."""
+
+    def __init__(self, engine=None, pool=None):
+        # engine=None is the pool-learner shape: no serving engine on
+        # this side of the process boundary, so only the pool-capacity
+        # signals exist and the ladder never has pressure to climb.
+        self.engine = engine
+        self.pool = pool
+        # name -> [last_raw, carry]; cumulative = carry + raw, and a
+        # raw value that DECREASED means the stat was reset, so the
+        # old total rolls into carry.
+        self._cum: Dict[str, List[float]] = {}
+
+    def _cumulative(self, name: str, raw: float) -> float:
+        slot = self._cum.setdefault(name, [0.0, 0.0])
+        if raw < slot[0]:
+            slot[1] += slot[0]
+        slot[0] = raw
+        return slot[1] + raw
+
+    def read(self) -> Dict[str, float]:
+        eng = self.engine
+        sig = {"queue_depth": 0.0, "running": 0.0,
+               "page_occupancy": 0.0, "spec_accept": 0.0,
+               "shed_total": 0.0, "ttft_p95": 0.0}
+        if eng is not None:
+            sched = eng.sched
+            num_pages = max(1, int(eng.num_pages))
+            # available_pages = free + evictable prefix-cache pages:
+            # cached pages are reclaimable on demand, so counting them
+            # as occupied (free_pages) would pin the occupancy signal
+            # near 1.0 forever once the cache warms and the ladder
+            # could never relax.
+            avail = getattr(sched, "available_pages", None)
+            if avail is None:
+                avail = sched.free_pages
+            sig.update({
+                "queue_depth": float(sched.waiting),
+                "running": float(sched.running),
+                "page_occupancy": 1.0 - float(avail) / num_pages,
+                "spec_accept": float(
+                    getattr(eng, "_spec_global_ema", 0.0)),
+                "shed_total": self._cumulative(
+                    "shed_requests", float(eng.shed_requests)),
+            })
+            # Wall-clock signal riding the telemetry histograms; only
+            # consulted when its setpoint is armed (ceiling > 0), so
+            # deterministic default configs never touch it.
+            tele = eng.telemetry
+            sig["ttft_p95"] = float(tele.ttft_s.percentile(95.0))
+            # Per-tenant SLO shed counters, reset-robust — the relax
+            # decision reads these to know whether the clamp is still
+            # absorbing load.
+            for key, ctr in tele.counters().items():
+                if (key.startswith("tenant_")
+                        and key.endswith("_shed")):
+                    sig[key] = self._cumulative(key, float(ctr.value))
+            # A reset drops per-tenant counters from the readout
+            # entirely (not just to zero) — fold the last raw value
+            # into the carry and keep reporting the total, so the
+            # tenant's next recorded shed continues from it.
+            for key, slot in self._cum.items():
+                if key.startswith("tenant_") and key not in sig:
+                    slot[1] += slot[0]
+                    slot[0] = 0.0
+                    sig[key] = slot[1]
+        if self.pool is not None:
+            sig["workers"] = float(len(self.pool.live_members()))
+        return sig
+
+
+class SLOAutopilot:
+    """The controller.  One instance per serving engine; drive it from
+    any pump loop via :meth:`maybe_tick` (wall-clock cadence) or
+    :meth:`tick` (explicit, deterministic).
+
+    ``spawn_fn`` / ``retire_fn`` are the elastic-capacity actuators:
+    spawn one worker process / retire one.  Both optional — without
+    them the capacity loop is observation-only.
+    """
+
+    def __init__(self, cfg: ControllerConfig, engine=None, pool=None,
+                 spawn_fn: Optional[Callable[[], object]] = None,
+                 retire_fn: Optional[Callable[[], object]] = None,
+                 clock=time.monotonic):
+        self.cfg = cfg
+        self.engine = engine
+        self.reader = SignalReader(engine, pool)
+        self.pool = pool
+        self.spawn_fn = spawn_fn
+        self.retire_fn = retire_fn
+        self._clock = clock
+        self._next_tick = None  # armed on first maybe_tick
+        self.rung = 0           # index into RUNGS
+        self.ticks = 0
+        #: (tick, kind, detail) tuples, primitives only — the replay
+        #: witness chaos tests compare across seeded runs.
+        self.decisions: List[Tuple] = []
+        self._hot = 0           # consecutive ticks past a ceiling
+        self._cool = 0          # consecutive ticks under every floor
+        self._last_transition = -10**9
+        self._last_capacity_act = -10**9
+        # Spec micro-controller streaks + baseline.
+        self._spec_low = 0
+        self._spec_high = 0
+        self._spec_boosted = False
+        # Baseline knob values captured at first escalation; tuned and
+        # relax actions restore exactly these.
+        self._baseline: Optional[Dict[str, float]] = None
+        # tenant -> envelope snapshot taken when the shed rung engaged.
+        self._saved_qos: Dict[str, Dict] = {}
+        self.counters_: Dict[str, int] = {
+            "autopilot_ticks": 0,
+            "autopilot_spawns": 0,
+            "autopilot_retires": 0,
+            "autopilot_sheds": 0,
+            "autopilot_relaxes": 0,
+            "autopilot_setpoint_changes": 0,
+            "autopilot_spawn_failures": 0,
+            "autopilot_decide_errors": 0,
+        }
+        self._runner: Optional[threading.Thread] = None
+        self._stop: Optional[threading.Event] = None
+
+    # -- public readouts -------------------------------------------------
+    def counters(self) -> Dict[str, float]:
+        """Float-valued counter snapshot in metrics-row shape (the
+        orchestrators merge this into every row; the gateway merges it
+        into ``stats``)."""
+        out = {k: float(v) for k, v in self.counters_.items()}
+        out["autopilot_rung"] = float(self.rung)
+        return out
+
+    # -- cadence ---------------------------------------------------------
+    def maybe_tick(self) -> Optional[Tuple]:
+        """Wall-clock-gated tick for pump loops: runs :meth:`tick` at
+        most once per ``cfg.tick_interval`` seconds."""
+        now = self._clock()
+        if self._next_tick is not None and now < self._next_tick:
+            return None
+        self._next_tick = now + self.cfg.tick_interval
+        return self.tick()
+
+    def start(self, watchdog=None) -> None:
+        """Optional standalone runner thread for hosts with no pump
+        loop to ride.  Supervised: registers with the caller's
+        watchdog so a hung controller is detected like any other
+        stalled component."""
+        if self._runner is not None:
+            raise RuntimeError("autopilot runner already started")
+        hb = (watchdog.register("autopilot",
+                                timeout=max(10.0,
+                                            10 * self.cfg.tick_interval))
+              if watchdog is not None else None)
+        self._stop = threading.Event()
+
+        def _run(stop=self._stop, beat=hb):
+            while not stop.wait(self.cfg.tick_interval):
+                if beat is not None:
+                    beat.beat()
+                self.tick()
+
+        self._runner = threading.Thread(
+            target=_run, name="slo-autopilot", daemon=True)
+        self._runner.start()
+
+    def stop(self) -> None:
+        if self._runner is None:
+            return
+        self._stop.set()
+        self._runner.join(timeout=5.0)
+        self._runner = None
+
+    # -- the decision tick ----------------------------------------------
+    def tick(self) -> Optional[Tuple]:
+        """One control decision.  Returns the transition tuple when the
+        ladder moved, else None.  Never raises: controller failure
+        (including an injected ``controller.decide`` fault) is counted
+        and skipped — see design rule 5."""
+        self.ticks += 1
+        self.counters_["autopilot_ticks"] += 1
+        try:
+            fault_point("controller.decide")
+            with obs.span("autopilot.decide", tick=self.ticks,
+                          rung=RUNGS[self.rung]):
+                sig = self.reader.read()
+                self._capacity_loop(sig)
+                self._spec_loop(sig)
+                return self._ladder(sig)
+        except Exception as e:  # noqa: BLE001 - fail open by design
+            self.counters_["autopilot_decide_errors"] += 1
+            obs.instant("autopilot.decide_error", tick=self.ticks,
+                        error=repr(e))
+            _LOG.warning("autopilot tick %d failed (serving unaffected):"
+                         " %r", self.ticks, e)
+            return None
+
+    # -- signal classification -------------------------------------------
+    def _band(self, sp: Setpoint, value: float) -> int:
+        """-1 under floor / 0 inside band / +1 past ceiling; disabled
+        setpoints (ceiling <= 0) always read as 0."""
+        if sp.ceiling <= 0:
+            return 0
+        if value > sp.ceiling:
+            return 1
+        if value <= sp.floor:
+            return -1
+        return 0
+
+    def _pressure(self, sig: Dict[str, float]) -> Dict[str, int]:
+        c = self.cfg
+        return {
+            "queue_depth": self._band(c.queue_depth, sig["queue_depth"]),
+            "page_occupancy": self._band(c.page_occupancy,
+                                         sig["page_occupancy"]),
+            "ttft": self._band(c.ttft, sig["ttft_p95"]),
+        }
+
+    # -- the degradation ladder ------------------------------------------
+    def _ladder(self, sig: Dict[str, float]) -> Optional[Tuple]:
+        bands = self._pressure(sig)
+        hot = any(b > 0 for b in bands.values())
+        cool = all(b < 0 or b == 0 and self._disabled(k)
+                   for k, b in bands.items())
+        self._hot = self._hot + 1 if hot else 0
+        self._cool = self._cool + 1 if cool else 0
+        c = self.cfg
+        if self.ticks - self._last_transition <= c.cooldown_ticks:
+            return None  # anti-flap: hold position after any move
+        if (hot and self._hot >= c.hold_ticks
+                and self.rung < len(RUNGS) - 1):
+            return self._transition(self.rung + 1, sig, bands)
+        if (cool and self._cool >= c.hold_ticks and self.rung > 0):
+            return self._transition(self.rung - 1, sig, bands)
+        return None
+
+    def _disabled(self, name: str) -> bool:
+        sp: Setpoint = getattr(self.cfg, name)
+        return sp.ceiling <= 0
+
+    def _transition(self, new_rung: int, sig, bands) -> Tuple:
+        old, new = RUNGS[self.rung], RUNGS[new_rung]
+        escalate = new_rung > self.rung
+        if escalate:
+            if new == "tuned":
+                self._enter_tuned()
+            elif new == "shed":
+                self._enter_shed()
+        else:
+            if old == "shed":
+                self._leave_shed()
+            elif old == "tuned":
+                self._leave_tuned()
+        self.rung = new_rung
+        self._last_transition = self.ticks
+        self._hot = self._cool = 0
+        decision = (self.ticks, "transition", f"{old}->{new}",
+                    tuple(sorted((k, v) for k, v in bands.items())))
+        self.decisions.append(decision)
+        obs.instant("autopilot.transition", tick=self.ticks,
+                    from_rung=old, to_rung=new)
+        # Forensics on EVERY ladder move: the flight recorder (when
+        # armed) captures what pushed the controller over the edge.
+        obs.flight_dump("autopilot-transition", {
+            "transition": f"{old}->{new}", "tick": self.ticks,
+            "signals": {k: round(float(v), 6) for k, v in sig.items()},
+            "counters": self.counters()})
+        _LOG.info("autopilot: %s -> %s at tick %d (signals %s)",
+                  old, new, self.ticks, bands)
+        return decision
+
+    # -- rung 1: tuned setpoints -----------------------------------------
+    def _capture_baseline(self) -> None:
+        if self._baseline is None and self.engine is not None:
+            eng = self.engine
+            self._baseline = {
+                "page_watermark": int(eng._watermark),
+                "chunked_prefill_tokens": int(eng._chunk),
+                "spec_breakeven": float(eng.cfg.spec_breakeven),
+            }
+
+    def _enter_tuned(self) -> None:
+        c = self.cfg
+        self._capture_baseline()
+        if self._baseline is None:
+            return  # no engine on this side (pool-learner shape)
+        kw: Dict = {}
+        if c.tuned_watermark_delta > 0:
+            # A HIGHER watermark reserves more free pages before the
+            # next admission: decode headroom for the already-running
+            # requests at the price of admission rate — exactly the
+            # trade the tuned rung wants under page pressure.
+            kw["page_watermark"] = (self._baseline["page_watermark"]
+                                    + c.tuned_watermark_delta)
+        if c.tuned_chunk_tokens > 0:
+            kw["chunked_prefill_tokens"] = c.tuned_chunk_tokens
+        if c.tuned_spec_breakeven > 0 and not self._spec_boosted:
+            kw["spec_breakeven"] = c.tuned_spec_breakeven
+        self._apply(kw)
+
+    def _leave_tuned(self) -> None:
+        base = self._baseline
+        if base is None:
+            return
+        kw = {"page_watermark": base["page_watermark"],
+              "chunked_prefill_tokens": base["chunked_prefill_tokens"]}
+        if not self._spec_boosted:
+            # The spec micro-controller owns the breakeven while a
+            # boost is active; don't yank it back under its feet.
+            kw["spec_breakeven"] = base["spec_breakeven"]
+        self._apply(kw)
+
+    def _apply(self, kw: Dict) -> Dict:
+        if not kw or self.engine is None:
+            return {}
+        changed = self.engine.apply_setpoints(**kw)
+        if changed:
+            self.counters_["autopilot_setpoint_changes"] += len(changed)
+            self.decisions.append(
+                (self.ticks, "setpoints",
+                 tuple(sorted((k, ov, nv)
+                              for k, (ov, nv) in changed.items()))))
+            obs.instant("autopilot.setpoints", tick=self.ticks,
+                        **{k: nv for k, (ov, nv) in changed.items()})
+        return changed
+
+    # -- rung 2: load shed ------------------------------------------------
+    def _enter_shed(self) -> None:
+        c = self.cfg
+        eng = self.engine
+        if eng is None:
+            return
+        clamped = []
+        for name, qos in sorted(eng._tenant_qos.items()):
+            if name in c.protect_tenants:
+                continue
+            self._saved_qos[name] = {
+                "weight": qos["weight"],
+                "rate_limit": qos["rate_limit"],
+                "max_queued": qos["max_queued"],
+                "max_running": qos["max_running"],
+            }
+            eng.configure_tenant(
+                name, weight=qos["weight"],
+                rate_limit=(c.shed_rate_limit if c.shed_rate_limit > 0
+                            else qos["rate_limit"]),
+                # min() so an envelope ALREADY tighter than the shed
+                # clamp stays tight (0 means unlimited, hence the or).
+                max_queued=min(qos["max_queued"] or c.shed_max_queued,
+                               c.shed_max_queued),
+                max_running=min(qos["max_running"] or c.shed_max_running,
+                                c.shed_max_running))
+            clamped.append(name)
+        self.counters_["autopilot_sheds"] += 1
+        self.decisions.append((self.ticks, "shed", tuple(clamped)))
+
+    def _leave_shed(self) -> None:
+        eng = self.engine
+        restored = []
+        for name, env in sorted(self._saved_qos.items()):
+            eng.configure_tenant(name, **env)
+            restored.append(name)
+        self._saved_qos.clear()
+        self.counters_["autopilot_relaxes"] += 1
+        self.decisions.append((self.ticks, "relax", tuple(restored)))
+
+    # -- speculative-acceptance micro-controller --------------------------
+    def _spec_loop(self, sig: Dict[str, float]) -> None:
+        """Independent of the ladder: when acceptance EMA falls under
+        its floor the verify chunks stop paying for themselves, so the
+        breakeven rises to ``tuned_spec_breakeven``; sustained recovery
+        past the ceiling restores the baseline.  Requires both the
+        setpoint (ceiling > 0) and a tuned value to move to."""
+        c = self.cfg
+        sp = c.spec_accept
+        if sp.ceiling <= 0 or c.tuned_spec_breakeven <= 0:
+            return
+        ema = sig["spec_accept"]
+        if ema <= 0:
+            return  # spec off or no evidence yet
+        self._spec_low = self._spec_low + 1 if ema < sp.floor else 0
+        self._spec_high = self._spec_high + 1 if ema > sp.ceiling else 0
+        if not self._spec_boosted and self._spec_low >= c.hold_ticks:
+            self._capture_baseline()
+            if self._apply({"spec_breakeven": c.tuned_spec_breakeven}):
+                self._spec_boosted = True
+                self.decisions.append(
+                    (self.ticks, "spec_boost", round(ema, 6)))
+        elif self._spec_boosted and self._spec_high >= c.hold_ticks:
+            self._apply(
+                {"spec_breakeven": self._baseline["spec_breakeven"]})
+            self._spec_boosted = False
+            self.decisions.append(
+                (self.ticks, "spec_restore", round(ema, 6)))
+
+    # -- elastic pool capacity --------------------------------------------
+    def _capacity_loop(self, sig: Dict[str, float]) -> None:
+        """Spawn below target, retire above ceiling, never below
+        floor.  One action per ``cooldown_ticks`` window — a spawned
+        worker needs time to HELLO before the gap re-measures, and
+        without the gate a dead pool would fork-bomb."""
+        c = self.cfg
+        sp = c.workers
+        if sp.target <= 0 or "workers" not in sig:
+            return
+        if self.ticks - self._last_capacity_act <= c.cooldown_ticks:
+            return
+        live = sig["workers"]
+        if live < sp.target and self.spawn_fn is not None:
+            try:
+                fault_point("worker.spawn")
+                self.spawn_fn()
+            except Exception as e:  # noqa: BLE001 - fail open
+                self.counters_["autopilot_spawn_failures"] += 1
+                self.decisions.append(
+                    (self.ticks, "spawn_failed", repr(e)))
+                obs.instant("autopilot.spawn_failed", tick=self.ticks,
+                            error=repr(e))
+                self._last_capacity_act = self.ticks
+                return
+            self.counters_["autopilot_spawns"] += 1
+            self._last_capacity_act = self.ticks
+            self.decisions.append(
+                (self.ticks, "spawn", int(live)))
+            obs.instant("autopilot.spawn", tick=self.ticks,
+                        live=int(live), target=sp.target)
+        elif (sp.ceiling > 0 and live > sp.ceiling
+              and live - 1 >= sp.floor and self.retire_fn is not None):
+            try:
+                self.retire_fn()
+            except Exception as e:  # noqa: BLE001 - fail open
+                self.decisions.append(
+                    (self.ticks, "retire_failed", repr(e)))
+                obs.instant("autopilot.retire_failed", tick=self.ticks,
+                            error=repr(e))
+                self._last_capacity_act = self.ticks
+                return
+            self.counters_["autopilot_retires"] += 1
+            self._last_capacity_act = self.ticks
+            self.decisions.append(
+                (self.ticks, "retire", int(live)))
+            obs.instant("autopilot.retire", tick=self.ticks,
+                        live=int(live), ceiling=sp.ceiling)

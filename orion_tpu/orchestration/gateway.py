@@ -151,11 +151,16 @@ class ServingGateway:
     def __init__(self, engine, port: int = 0, host: str = "localhost",
                  tenants: Optional[Dict[str, dict]] = None,
                  recv_deadline: float = 0.0, tracer=None,
-                 idle_wait: float = 0.002):
+                 idle_wait: float = 0.002, autopilot=None):
         self.engine = engine
         self.host = host
         self._tracer = tracer
         self._idle_wait = idle_wait
+        # Optional SLO autopilot (orchestration.autopilot): the pump
+        # loop is its cadence source, so one thread owns both the
+        # engine AND every setpoint/QoS actuation — no locking between
+        # controller and serving.
+        self.autopilot = autopilot
         self.recv_deadline = recv_deadline
         for name, kw in (tenants or {}).items():
             engine.configure_tenant(name, **kw)
@@ -432,6 +437,14 @@ class ServingGateway:
                 raise RuntimeError(f"unknown gateway op {op!r}")
         if self.engine.pending:
             self.engine.step()
+        if self.autopilot is not None:
+            # Wall-clock-gated inside: at most one decision per
+            # cfg.controller.tick_interval regardless of pump rate.
+            before = self.autopilot.ticks
+            self.autopilot.maybe_tick()
+            if self.autopilot.ticks != before:
+                with self._lock:
+                    self.stats.update(self.autopilot.counters())
         return int(self.engine.pending)
 
     def serve_forever(self, stop: Optional[threading.Event] = None,
@@ -608,6 +621,60 @@ class GatewayClient:
             "budget": budget, "priority": int(priority),
             "deadline": deadline})
         return int(req_id)
+
+    def submit_with_backoff(self, ids, budget: Optional[int] = None,
+                            priority: int = 0,
+                            deadline: Optional[int] = None,
+                            policy=None,
+                            event_timeout: float = 30.0,
+                            sleep=time.sleep):
+        """Submit with typed-backpressure retries: a shed
+        (:class:`EngineOverloaded` riding the first StreamEvent) is
+        retried under ``policy`` (a ``resilience.policy.RetryPolicy``;
+        default 4 seeded-jitter attempts), sleeping at least the
+        engine's ``retry_after`` hint each time.  Returns
+        ``(req_id, first_event)`` for the attempt that was admitted;
+        raises the final :class:`EngineOverloaded` once the budget is
+        exhausted.  Events for OTHER in-flight requests arriving while
+        we wait are re-queued, not dropped."""
+        from orion_tpu.resilience import RetryPolicy
+
+        if policy is None:
+            # Seeded per-cid jitter: simultaneous sheds across clients
+            # desynchronize instead of re-stampeding in lockstep.
+            policy = RetryPolicy(max_attempts=4, base_delay=0.05,
+                                 jitter=0.5, seed=self.cid,
+                                 retry_on=(EngineOverloaded,))
+        hint = [0.0]   # retry_after from the most recent shed
+
+        def _attempt():
+            rid = self.submit(ids, budget=budget, priority=priority,
+                              deadline=deadline)
+            stash = []
+            try:
+                while True:
+                    ev = self.next_event(timeout=event_timeout)
+                    if ev is None:
+                        raise TimeoutError(
+                            f"no event for request {rid} within "
+                            f"{event_timeout}s")
+                    if ev.req_id != rid:
+                        stash.append(ev)
+                        continue
+                    if isinstance(ev.error, EngineOverloaded):
+                        hint[0] = float(ev.error.retry_after or 0.0)
+                        raise ev.error
+                    return rid, ev
+            finally:
+                for s in stash:
+                    self._events.put(s)
+
+        def _sleep(delay: float) -> None:
+            # The policy's jittered schedule is the floor; the
+            # engine's own drain estimate wins when longer.
+            sleep(max(float(delay), hint[0]))
+
+        return policy.call(_attempt, sleep=_sleep)
 
     def cancel(self, req_id: int) -> None:
         self.chan.send_frame(FRAME_CANCEL, {"req": int(req_id)})

@@ -42,7 +42,24 @@ FAULT_POINTS = frozenset({
     "worker.hello",       # pool worker admission handshake
     "worker.heartbeat",   # pool worker heartbeat send (fires = missed beat)
     "worker.traj",        # pool worker trajectory send
+    "worker.spawn",       # launch.py / autopilot worker-process spawn
+    "controller.decide",  # SLO autopilot decision tick
 })
+
+
+def _unknown_point_error(unknown) -> str:
+    """Arm-time error for a typo'd fault point, with did-you-mean
+    suggestions — ``rollout.genrate`` must fail loudly at plan
+    construction, never silently arm nothing."""
+    import difflib
+
+    parts = []
+    for name in sorted(unknown):
+        close = difflib.get_close_matches(name, sorted(FAULT_POINTS), n=1)
+        parts.append(f"{name!r}" + (f" (did you mean {close[0]!r}?)"
+                                    if close else ""))
+    return (f"unknown fault point(s) {', '.join(parts)}; known: "
+            f"{sorted(FAULT_POINTS)}")
 
 
 class InjectedFault(RuntimeError):
@@ -103,9 +120,7 @@ class FaultPlan:
     def __init__(self, spec: Mapping[str, Mapping], seed: int = 0):
         unknown = set(spec) - FAULT_POINTS
         if unknown:
-            raise ValueError(
-                f"unknown fault point(s) {sorted(unknown)}; known: "
-                f"{sorted(FAULT_POINTS)}")
+            raise ValueError(_unknown_point_error(unknown))
         self.seed = seed
         self._specs: Dict[str, _PointSpec] = {
             name: (kw if isinstance(kw, _PointSpec)

@@ -250,6 +250,7 @@ class ContinuousBatchingEngine:
         self.num_pages = cfg.num_pages or self.slots * self.pages_per_seq
         wm = (cfg.page_watermark if cfg.page_watermark >= 0
               else self.slots)
+        self._watermark = wm
         self.sched = Scheduler(self.num_pages, ps, self.slots,
                                watermark=wm, policy=cfg.admission_policy)
 
@@ -1154,8 +1155,72 @@ class ContinuousBatchingEngine:
             bucket = TokenBucket(rate_limit,
                                  burst if burst is not None
                                  else max(float(rate_limit), 1.0))
+        # rate_limit / max_running ride along so the envelope can be
+        # read BACK (the autopilot's shed rung snapshots it before
+        # clamping and restores it verbatim on relax).
         self._tenant_qos[name] = {"weight": int(weight), "bucket": bucket,
-                                  "max_queued": int(max_queued)}
+                                  "max_queued": int(max_queued),
+                                  "rate_limit": float(rate_limit),
+                                  "max_running": int(max_running)}
+
+    def apply_setpoints(self, page_watermark: Optional[int] = None,
+                        chunked_prefill_tokens: Optional[int] = None,
+                        spec_breakeven: Optional[float] = None) -> dict:
+        """Retune the serving knobs of a LIVE engine (the SLO
+        autopilot's actuator; PR 13).  Each knob is optional; only the
+        ones passed change.  Returns ``{knob: (old, new)}`` for every
+        knob whose effective value actually changed — the empty dict
+        means the call was a no-op, which the controller uses to avoid
+        counting phantom setpoint changes.
+
+        - ``page_watermark`` re-aims the scheduler's admission-headroom
+          reserve (takes effect at the next admit; in-flight
+          reservations untouched);
+        - ``chunked_prefill_tokens`` re-caps the prefill chunk budget
+          for FUTURE admissions (a repetition penalty != 1.0 still
+          forces 0 — same rule as construction, degrade loudly);
+        - ``spec_breakeven`` moves the speculative-decoding breakeven
+          threshold the per-wave spec gate reads live.
+        """
+        changed: dict = {}
+        if page_watermark is not None:
+            new_wm = int(page_watermark)
+            if new_wm < 0:
+                raise ValueError(
+                    f"page_watermark must be >= 0, got {new_wm}")
+            if new_wm != self._watermark:
+                self.sched.set_watermark(new_wm)
+                changed["page_watermark"] = (self._watermark, new_wm)
+                self._watermark = new_wm
+        if chunked_prefill_tokens is not None:
+            new_ct = int(chunked_prefill_tokens)
+            if new_ct < 0:
+                raise ValueError(
+                    f"chunked_prefill_tokens must be >= 0, got {new_ct}")
+            eff = new_ct if self.cfg.repetition_penalty == 1.0 else 0
+            if eff != new_ct:
+                import warnings
+
+                warnings.warn(
+                    "apply_setpoints: repetition_penalty != 1.0 forces "
+                    "chunked_prefill_tokens to 0 (the penalty's "
+                    "seen-set needs the full prompt forward)",
+                    stacklevel=2)
+            if eff != self._chunk:
+                changed["chunked_prefill_tokens"] = (self._chunk, eff)
+                self._chunk = eff
+        if spec_breakeven is not None:
+            new_be = float(spec_breakeven)
+            if new_be < 1.0:
+                raise ValueError(
+                    f"spec_breakeven must be >= 1.0, got {new_be}")
+            if new_be != self.cfg.spec_breakeven:
+                changed["spec_breakeven"] = (self.cfg.spec_breakeven,
+                                             new_be)
+                # The per-wave spec gate reads cfg.spec_breakeven live,
+                # so the config object IS the knob's storage.
+                self.cfg.spec_breakeven = new_be
+        return changed
 
     def _retry_after_hint(self) -> float:
         """Backpressure hint: the recent mean queue wait approximates

@@ -163,6 +163,16 @@ class Scheduler {
     return 0;
   }
 
+  // Re-aim the admission-headroom watermark online (the autopilot's
+  // page-pressure actuator).  Takes effect at the next Admit();
+  // in-flight reservations are untouched.  -1 on a negative value —
+  // same validity rule as construction.
+  int SetWatermark(int watermark) {
+    if (watermark < 0) return -1;
+    watermark_ = watermark;
+    return 0;
+  }
+
   // Remove a WAITING request (the engine's abort path — a running
   // request is preempted first, which requeues it as waiting).
   // Returns 0, or -1 when no waiting entry carries the id.
@@ -636,6 +646,10 @@ int osch_set_tenant(void* h, int64_t tenant, int64_t weight,
                     int64_t max_running) {
   return static_cast<Scheduler*>(h)->SetTenant(tenant, weight,
                                                max_running);
+}
+
+int osch_set_watermark(void* h, int watermark) {
+  return static_cast<Scheduler*>(h)->SetWatermark(watermark);
 }
 
 int osch_cancel(void* h, int64_t id) {

@@ -167,6 +167,8 @@ def _bind(so: Optional[str]):
     lib.osch_set_tenant.restype = ctypes.c_int
     lib.osch_set_tenant.argtypes = [ctypes.c_void_p, ctypes.c_int64,
                                     ctypes.c_int64, ctypes.c_int64]
+    lib.osch_set_watermark.restype = ctypes.c_int
+    lib.osch_set_watermark.argtypes = [ctypes.c_void_p, ctypes.c_int]
     lib.osch_cancel.restype = ctypes.c_int
     lib.osch_cancel.argtypes = [ctypes.c_void_p, ctypes.c_int64]
     lib.osch_admit.restype = ctypes.c_int
@@ -249,6 +251,14 @@ class _NativeScheduler:
             raise ValueError(
                 f"bad tenant params: weight={weight} (>= 1), "
                 f"max_running={max_running} (>= 0)")
+
+    def set_watermark(self, watermark: int) -> None:
+        """Re-aim the admission-headroom watermark online (the
+        autopilot's page-pressure actuator); takes effect at the next
+        ``admit``."""
+        if self._lib.osch_set_watermark(self._h, int(watermark)) != 0:
+            raise ValueError(
+                f"watermark must be >= 0, got {watermark}")
 
     def cancel(self, req_id: int) -> None:
         """Remove a WAITING request (running ones are preempted first
@@ -420,6 +430,15 @@ class PyScheduler:
         t = self._tenants.setdefault(tenant, [1, 0, 0, 0])
         t[0] = weight
         t[2] = max_running
+
+    def set_watermark(self, watermark: int) -> None:
+        """Re-aim the admission-headroom watermark online (the
+        autopilot's page-pressure actuator); takes effect at the next
+        ``admit``."""
+        if watermark < 0:
+            raise ValueError(
+                f"watermark must be >= 0, got {watermark}")
+        self._watermark = int(watermark)
 
     def cancel(self, req_id: int) -> None:
         """Remove a WAITING request (running ones are preempted first

@@ -243,6 +243,17 @@ class BaseTrainer:
 
             install_plan(plan_from_spec(cfg.resilience.fault_plan,
                                         seed=cfg.resilience.fault_seed))
+        else:
+            # Eager env arming: a typo'd ORION_FAULT_PLAN point
+            # ("rollout.genrate") must raise HERE, at arm time — the
+            # lazy first-hit path would silently arm nothing until a
+            # fault point fires, which for a misspelled point is never.
+            from orion_tpu.resilience.inject import (install_plan,
+                                                     plan_from_env)
+
+            env_plan = plan_from_env()
+            if env_plan is not None:
+                install_plan(env_plan)
         self.writer = None
         if cfg.log_dir:
             from orion_tpu.utils.metrics import MetricsWriter
