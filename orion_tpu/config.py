@@ -299,6 +299,14 @@ class RolloutConfig:
     # repetition_penalty != 1.0 (the seen-set would need the full
     # prompt the skipped prefill never sees).
     prefix_cache: bool = True
+    # Host-RAM KV tier (PR 17): when > 0, a prefix-cache page LRU-
+    # evicted from the device pool spills its KV into a byte-budgeted
+    # host cache of this many bytes instead of being dropped, and a
+    # later prefix hit re-admits it device-side, skipping the prefill
+    # forward — same chain-hash keying, so hits are bit-identical KV.
+    # 0 disables the tier (single-tier PR 8 behavior).  Requires
+    # prefix_cache; flushed together with it on weight reload.
+    host_cache_bytes: int = 0
     # Chunked prefill: admission prefill runs at most this many tokens
     # per wave, so a long prompt is spread across decode segments
     # instead of stalling every in-flight slot for one full-width
@@ -402,6 +410,10 @@ class RolloutConfig:
             raise ValueError(
                 f"chunked_prefill_tokens must be >= 0 (0 disables), got "
                 f"{self.chunked_prefill_tokens}")
+        if self.host_cache_bytes < 0:
+            raise ValueError(
+                f"host_cache_bytes must be >= 0 (0 disables the host "
+                f"KV tier), got {self.host_cache_bytes}")
         if self.max_queued_requests < 0:
             raise ValueError(
                 f"max_queued_requests must be >= 0 (0 = unlimited), "

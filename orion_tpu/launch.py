@@ -374,6 +374,28 @@ def spawn_pool_workers(algo: str, argv: list, port: int, n: int) -> list:
     return procs
 
 
+def _retire_pool_worker(pool, procs: list) -> int:
+    """Retire half of the elastic-capacity actuator pair (PR 17):
+    GOODBYE the newest live pool member (``WorkerPool.retire_member``
+    — LIFO, so the longest-warmed workers keep serving) and sweep
+    already-exited children out of the reap list so a long elastic run
+    does not accumulate zombie Popen handles.  The retired worker
+    exits through its normal graceful path (finish in-flight batch →
+    leave), so its queued trajectories stay consumable; the final
+    ``_reap_pool_workers`` at shutdown waits for stragglers.  Raises
+    when there is nothing to retire — the autopilot records that as a
+    ``retire_failed`` event instead of silently counting a no-op as a
+    scale-down."""
+    wid = pool.retire_member()
+    if wid is None:
+        raise RuntimeError("retire requested but the pool has no live "
+                           "members")
+    # poll() reaps an exited child (clears the zombie) and returns
+    # None for one still running — keep those for the exit reap.
+    procs[:] = [p for p in procs if p.poll() is None]
+    return wid
+
+
 def _reap_pool_workers(procs: list, timeout: float = 60.0) -> None:
     """Wait for GOODBYE'd workers to exit; escalate to terminate/kill
     so a wedged worker can never hang the launcher's exit."""
@@ -534,6 +556,12 @@ def main(argv: Optional[list] = None) -> Any:
                 # too.
                 orch.autopilot.spawn_fn = lambda: procs.extend(
                     spawn_pool_workers(algo, raw_argv, orch.pool.port, 1))
+                # Retire actuator (PR 17): the other half of elastic
+                # capacity — GOODBYE one worker through the pool and
+                # sweep exited Popen handles so scale-down cycles do
+                # not leak zombies until launcher exit.
+                orch.autopilot.retire_fn = lambda: _retire_pool_worker(
+                    orch.pool, procs)
             try:
                 return orch.train(prompt_iter, eval_iter=eval_iter)
             finally:

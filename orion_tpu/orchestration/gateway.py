@@ -151,11 +151,22 @@ class ServingGateway:
     def __init__(self, engine, port: int = 0, host: str = "localhost",
                  tenants: Optional[Dict[str, dict]] = None,
                  recv_deadline: float = 0.0, tracer=None,
-                 idle_wait: float = 0.002, autopilot=None):
+                 idle_wait: float = 0.002, autopilot=None,
+                 prefill_tier=None):
         self.engine = engine
         self.host = host
         self._tracer = tracer
         self._idle_wait = idle_wait
+        # Optional disaggregated prefill tier (PR 17): a
+        # PrefillTierCoordinator fronting a PrefillWorker process.
+        # Submits route through it (KV arrives pre-computed, the
+        # engine prefix-hits it) and the pump drives its EDF
+        # admissions; sheds from the DEFERRED engine.submit come back
+        # through _on_tier_shed so the client still gets its typed
+        # overloaded/bad-request STREAM frame.
+        self.prefill_tier = prefill_tier
+        if prefill_tier is not None and prefill_tier.on_shed is None:
+            prefill_tier.on_shed = self._on_tier_shed
         # Optional SLO autopilot (orchestration.autopilot): the pump
         # loop is its cadence source, so one thread owns both the
         # engine AND every setpoint/QoS actuation — no locking between
@@ -331,6 +342,24 @@ class ServingGateway:
         with self._lock:
             rid = self._next_rid
             self._next_rid += 1
+        if self.prefill_tier is not None:
+            # Tier route: the request is live from the client's view
+            # the moment it parks tier-side; engine admission (and any
+            # shed) happens at the pump that sees its KV arrive, and
+            # comes back through _on_tier_shed.
+            with self._lock:
+                client.reqs[creq] = rid
+                self._live[rid] = (client, creq)
+                self.stats["submits"] += 1
+            self.prefill_tier.submit(
+                rid, np.asarray(p["ids"], np.int32),
+                budget=p.get("budget"),
+                priority=int(p.get("priority", 0)),
+                deadline=p.get("deadline"),
+                tenant=client.tenant, stream=True,
+                on_tokens=lambda chunk, c=client, q=creq:
+                    self._on_chunk(c, q, chunk))
+            return
         try:
             self.engine.submit(
                 rid, np.asarray(p["ids"], np.int32),
@@ -361,12 +390,42 @@ class ServingGateway:
                 "tokens": np.empty(0, np.int32),
                 "error": "bad-request", "message": str(e)})
 
+    def _on_tier_shed(self, rid: int, exc: Exception) -> None:
+        """Deferred-admission failure from the prefill tier's pump:
+        the engine refused the request AFTER its KV came back.  The
+        client gets the same typed STREAM error the direct path sends
+        synchronously."""
+        with self._lock:
+            entry = self._live.pop(rid, None)
+        if entry is None:
+            return  # client already gone
+        client, creq = entry
+        with self._lock:
+            client.reqs.pop(creq, None)
+        if isinstance(exc, EngineOverloaded):
+            with self._lock:
+                self.stats["sheds"] += 1
+            self._send_stream(client, {
+                "req": creq, "done": True,
+                "tokens": np.empty(0, np.int32), "error": "overloaded",
+                "message": str(exc), "queue_depth": exc.queue_depth,
+                "retry_after": exc.retry_after, "tenant": exc.tenant})
+        else:
+            self._send_stream(client, {
+                "req": creq, "done": True,
+                "tokens": np.empty(0, np.int32),
+                "error": "bad-request", "message": str(exc)})
+
     def _apply_cancel(self, client: _Client, p: dict) -> None:
         creq = int(p["req"])
         with self._lock:
             rid = client.reqs.get(creq)
         if rid is None:
             return  # finished (or never existed): cancel is a no-op
+        if self.prefill_tier is not None:
+            # Still parked tier-side?  Forget it there too; the
+            # engine-side cancel below is then the no-op.
+            self.prefill_tier.cancel(rid)
         try:
             self.engine.cancel(rid)
         except KeyError:
@@ -435,6 +494,14 @@ class ServingGateway:
                         pass
             else:  # pragma: no cover - internal op enum
                 raise RuntimeError(f"unknown gateway op {op!r}")
+        if self.prefill_tier is not None:
+            # EDF-admit every request whose prefilled KV arrived (or
+            # cold-admit everything if the tier died) BEFORE the wave,
+            # and surface the tier-labelled counters.
+            self.prefill_tier.pump()
+            with self._lock:
+                self.stats.update({"prefill_" + k: v for k, v in
+                                   self.prefill_tier.stats.items()})
         if self.engine.pending:
             self.engine.step()
         if self.autopilot is not None:

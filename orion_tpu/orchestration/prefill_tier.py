@@ -1,0 +1,439 @@
+"""Disaggregated prefill tier over ORTP (ISSUE 17 tentpole, part b).
+
+Chunked prefill and token decode have opposite resource shapes —
+prefill is compute-bound and bursty, decode is memory-bandwidth-bound
+and steady — so co-locating them makes every long prompt a head-of-
+line stall for every decoding request.  This module splits them across
+processes: a :class:`PrefillWorker` owns its own engine (same weights,
+same page-size config) and runs ONLY the prefill forward for offered
+prompts; the finished KV pages ship back over the hardened ORTP
+channel and are injected into the decode engine's device prefix cache
+(``Scheduler.insert_cached`` + one pool upload — the exact re-admit
+path the host-RAM tier uses), so the decode side's ``submit`` sees a
+prefix-cache hit and skips the prefill forward entirely.
+
+Third frame family on the channel (protocol v6):
+
+- ``FRAME_KV_OFFER``  decode → prefill: request id + prompt ids +
+  deadline — "prefill this for me";
+- ``FRAME_KV_PAGES``  prefill → decode: the ordered chain of
+  ``(chain_hash, per-layer KV)`` pages for the prompt's cacheable
+  prefix (possibly empty — the decode side then falls back to a local
+  cold prefill, bit-identically);
+- ``FRAME_KV_ACK``    decode → prefill: how many of those pages were
+  actually injected (telemetry/backpressure witness).
+
+HELLO / GOODBYE are shared with the pool protocol, as in the gateway.
+
+Correctness stance: pages are keyed by the SAME chain hash the decode
+engine computes in ``_page_hashes``, so an injected page is
+bit-identical KV by construction, and the decode engine caps cached
+pages at ``(plen-1)//page_size`` — at least one prompt token always
+re-forwards locally for the first sample's logits.  The prefill worker
+therefore never ships sampler state, only KV.  Every failure mode
+(worker dead, page didn't fit, ``kv.handoff`` chaos fault) degrades to
+the decode engine's own cold prefill — slower, never different.
+
+Threading mirrors the gateway: the decode engine stays single-owner.
+The coordinator's receive thread only parses frames and enqueues
+arrivals; :meth:`PrefillTierCoordinator.pump` (called from the
+gateway's pump loop, which owns the engine) injects KV and admits the
+pending requests in EDF order.
+"""
+
+from __future__ import annotations
+
+import logging
+import pickle
+import queue
+import threading
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from orion_tpu import obs
+from orion_tpu.orchestration.remote import (FRAME_GOODBYE, FRAME_HELLO,
+                                            PROTOCOL_VERSION,
+                                            ProtocolError, PyTreeChannel,
+                                            listen_socket)
+from orion_tpu.resilience import Watchdog, fault_point
+from orion_tpu.resilience.inject import InjectedFault
+from orion_tpu.rollout.continuous import EngineOverloaded
+
+_LOG = logging.getLogger(__name__)
+
+# The prefill-tier KV handoff family (PROTOCOL_VERSION 6).  A third
+# disjoint range (pool 0-6, gateway 16-18) so a frame number in a log
+# unambiguously names its family.
+FRAME_KV_OFFER = 32   # decode → prefill: prefill this prompt
+FRAME_KV_PAGES = 33   # prefill → decode: ordered (hash, KV) chain
+FRAME_KV_ACK = 34     # decode → prefill: injected-page count
+
+_FRAME_NAMES = {
+    FRAME_HELLO: "HELLO", FRAME_GOODBYE: "GOODBYE",
+    FRAME_KV_OFFER: "KV_OFFER", FRAME_KV_PAGES: "KV_PAGES",
+    FRAME_KV_ACK: "KV_ACK",
+}
+
+
+class PrefillWorker:
+    """Prefill-only worker: serves KV_OFFER frames from one decode
+    peer at a time.
+
+    The engine (caller-built, weights loaded, ``prefix_cache=True``)
+    is used as a prefill device: each offered prompt runs through
+    ``submit(budget=1)`` to completion, which graduates its full
+    prompt pages into the worker's OWN device prefix cache; the worker
+    then walks the prompt's chain hashes through ``cache_lookup`` and
+    ships each resident page's KV host-side (``_fetch_page``) as a
+    KV_PAGES frame.  A hash missing from the worker's cache (evicted
+    under its own pressure, or the prompt exceeded the worker's
+    limits) truncates the shipped chain — chain order is the contract,
+    a later page is useless without every earlier one.
+    """
+
+    def __init__(self, engine, port: int = 0, host: str = "localhost",
+                 recv_deadline: float = 0.0, tracer=None,
+                 accept_timeout: float = 0.5):
+        self.engine = engine
+        self.host = host
+        self.recv_deadline = recv_deadline
+        self._tracer = tracer
+        self._stop = threading.Event()
+        self._next_rid = 0
+        self.stats = {"offers": 0, "pages_shipped": 0,
+                      "acks": 0, "pages_injected": 0}
+        self._srv = listen_socket(port, host=host, backlog=1,
+                                  accept_timeout=accept_timeout)
+        self.port = self._srv.getsockname()[1]
+
+    # -- serving ---------------------------------------------------------
+    def serve(self, stop: Optional[threading.Event] = None) -> None:
+        """Blocking accept-and-serve loop until ``stop`` (or
+        :meth:`close`).  One decode peer at a time: a session ends on
+        GOODBYE or a broken channel, and the worker goes back to
+        accepting — a restarted decode side reconnects to a warm
+        worker cache."""
+        import socket as _socket
+
+        while not self._stop.is_set():
+            if stop is not None and stop.is_set():
+                return
+            try:
+                conn, addr = self._srv.accept()
+            except _socket.timeout:
+                continue
+            except OSError:
+                if self._stop.is_set():
+                    return
+                raise
+            try:
+                self._serve_session(conn, stop)
+            except (ProtocolError, ConnectionError, TimeoutError,
+                    pickle.UnpicklingError, OSError) as e:
+                _LOG.warning("prefill worker session ended: %s", e)
+
+    def _serve_session(self, conn, stop) -> None:
+        chan = PyTreeChannel(conn, recv_deadline=self.recv_deadline,
+                             tracer=self._tracer)
+        try:
+            kind, hello = chan.recv_frame()
+            if kind != FRAME_HELLO:
+                raise ProtocolError(
+                    f"expected HELLO, got "
+                    f"{_FRAME_NAMES.get(kind, kind)}")
+            chan.send_frame(FRAME_HELLO,
+                            {"protocol": PROTOCOL_VERSION,
+                             "role": "prefill"})
+            if obs.get_tracer().enabled:
+                obs.instant("kv.peer-join",
+                            name=str(hello.get("name", "decode")))
+            while not self._stop.is_set() and \
+                    not (stop is not None and stop.is_set()):
+                kind, payload = chan.recv_frame()
+                if kind == FRAME_KV_OFFER:
+                    self._handle_offer(chan, payload)
+                elif kind == FRAME_KV_ACK:
+                    self.stats["acks"] += 1
+                    self.stats["pages_injected"] += int(
+                        payload.get("injected", 0))
+                elif kind == FRAME_GOODBYE:
+                    return
+                else:
+                    raise ProtocolError(
+                        f"unexpected {_FRAME_NAMES.get(kind, kind)} "
+                        "frame from decode peer")
+        finally:
+            chan.close()
+
+    def _handle_offer(self, chan: PyTreeChannel, payload: dict) -> None:
+        rid = int(payload["req"])
+        ids = np.asarray(payload["ids"], np.int32)
+        self.stats["offers"] += 1
+        with obs.span("kv.prefill", req=rid, prompt_len=len(ids)):
+            pages = self._prefill_pages(ids)
+        self.stats["pages_shipped"] += len(pages)
+        chan.send_frame(FRAME_KV_PAGES, {"req": rid, "pages": pages})
+
+    def _prefill_pages(self, ids: np.ndarray
+                       ) -> List[Tuple[int, Any]]:
+        """Run the prompt through this worker's engine and extract the
+        cacheable prefix's (hash, KV) chain.  Any engine-side refusal
+        (prompt too long for THIS worker's config, QoS shed) ships an
+        empty chain — the decode side's cold prefill is the universal
+        fallback, so a prefill-tier limitation can never reject a
+        request the decode engine would have served."""
+        eng = self.engine
+        hashes = eng._page_hashes(ids)
+        if not hashes:
+            return []
+        rid = self._next_rid
+        self._next_rid += 1
+        try:
+            # budget=1: the cheapest run that still GRADUATES the
+            # prompt pages into this worker's prefix cache (graduation
+            # happens on completion).
+            eng.submit(rid, ids, budget=1)
+        except (EngineOverloaded, ValueError) as e:
+            _LOG.warning("prefill worker cannot serve offer: %s", e)
+            return []
+        while eng.pending:
+            eng.step()
+        resident: List[Tuple[int, int]] = []
+        for h in hashes:
+            page = eng.sched.cache_lookup(h)
+            if page < 0:
+                break  # chain truncated: evicted under local pressure
+            resident.append((h, page))
+        if not resident:
+            return []
+        rows = eng._fetch_pages([page for _, page in resident])
+        return [(h, data) for (h, _), data in zip(resident, rows)]
+
+    def close(self) -> None:
+        self._stop.set()
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+
+
+class PrefillTierCoordinator:
+    """Decode-side front for one :class:`PrefillWorker`.
+
+    ``submit`` mirrors the engine's signature but routes the prompt
+    through the prefill tier first: the prompt ships as a KV_OFFER,
+    the request parks in a pending map, and when its KV_PAGES frame
+    arrives :meth:`pump` injects the pages into the decode engine's
+    device cache and calls the REAL ``engine.submit`` — which then
+    prefix-hits the injected pages.  Arrivals are admitted in EDF
+    order (earliest deadline first; deadline-less requests last, then
+    request-id order) so a burst of returning prefills cannot starve
+    the tightest SLO.
+
+    Failure handling is strictly degrade-to-cold-prefill: a dead
+    channel (send failure, worker GOODBYE) or a ``kv.handoff`` chaos
+    fault skips the injection and admits the request with whatever the
+    device cache already holds — bit-identical tokens, just slower.
+    ``EngineOverloaded`` (and ``ValueError``) raised by the deferred
+    ``engine.submit`` surfaces through ``on_shed(req_id, exc)``
+    because the caller's own submit() returned long ago; without a
+    callback the exception propagates out of :meth:`pump`.
+    """
+
+    def __init__(self, engine, port: int, host: str = "localhost",
+                 on_shed: Optional[Callable[[int, Exception], None]] = None,
+                 connect_timeout: float = 30.0,
+                 recv_deadline: float = 0.0, tracer=None):
+        self.engine = engine
+        self.on_shed = on_shed
+        self._closed = threading.Event()
+        self._pending: Dict[int, dict] = {}   # rid -> stashed submit
+        self._arrived: queue.Queue = queue.Queue()
+        self._lock = threading.Lock()
+        self.stats = {"offers": 0, "handoffs": 0, "pages_injected": 0,
+                      "fallbacks": 0, "sheds": 0}
+        self.chan = PyTreeChannel.connect(
+            port, host=host, timeout=connect_timeout,
+            recv_deadline=recv_deadline, tracer=tracer)
+        self.chan.send_frame(FRAME_HELLO,
+                             {"role": "decode",
+                              "protocol": PROTOCOL_VERSION})
+        kind, ack = self.chan.recv_frame()
+        if kind != FRAME_HELLO:
+            self.chan.close()
+            raise ProtocolError(
+                f"expected HELLO ack, got "
+                f"{_FRAME_NAMES.get(kind, kind)}")
+        self.watchdog = Watchdog()
+        rx_hb = self.watchdog.register("kv-coord-rx", timeout=0.0)
+        self._rx_thread = threading.Thread(
+            target=self._recv_loop, args=(rx_hb,),
+            name="kv-coord-recv", daemon=True)
+        self._rx_thread.start()
+
+    def _recv_loop(self, hb) -> None:
+        """Parse-and-enqueue only — the pump owns the engine."""
+        try:
+            while not self._closed.is_set():
+                hb.beat()
+                kind, payload = self.chan.recv_frame()
+                if kind == FRAME_KV_PAGES:
+                    self._arrived.put(payload)
+                elif kind == FRAME_GOODBYE:
+                    self._closed.set()
+                    return
+                else:
+                    raise ProtocolError(
+                        f"unexpected {_FRAME_NAMES.get(kind, kind)} "
+                        "frame from prefill worker")
+        except (ConnectionError, TimeoutError, OSError, EOFError,
+                pickle.UnpicklingError):
+            # Dead worker: pump's next pass cold-admits everything
+            # still pending — the tier degrades, requests survive.
+            self._closed.set()
+
+    # -- request surface -------------------------------------------------
+    def submit(self, req_id: int, ids, budget: Optional[int] = None,
+               priority: int = 0, deadline: Optional[int] = None,
+               tenant="default", stream: bool = False, on_tokens=None,
+               logprobs: bool = False) -> None:
+        """Route one request through the prefill tier.  The engine
+        admission (QoS gates included) happens at the later
+        :meth:`pump` that sees its KV arrive — sheds surface via
+        ``on_shed``."""
+        ids = np.asarray(ids, np.int32)
+        entry = {"ids": ids,
+                 "kw": dict(budget=budget, priority=priority,
+                            deadline=deadline, tenant=tenant,
+                            stream=stream, on_tokens=on_tokens,
+                            logprobs=logprobs),
+                 "deadline": deadline}
+        rid = int(req_id)
+        with self._lock:
+            self._pending[rid] = entry
+        self.stats["offers"] += 1
+        if self._closed.is_set():
+            return  # pump cold-admits it
+        try:
+            self.chan.send_frame(FRAME_KV_OFFER,
+                                 {"req": rid, "ids": ids,
+                                  "deadline": deadline})
+        except (ConnectionError, TimeoutError, OSError) as e:
+            _LOG.warning("prefill offer for req %d failed (%r); "
+                         "falling back to local prefill", rid, e)
+            self._closed.set()
+
+    def cancel(self, req_id: int) -> bool:
+        """Forget a request still parked tier-side (not yet admitted
+        to the engine).  Returns whether anything was pending — the
+        caller still cancels engine-side for an admitted request."""
+        with self._lock:
+            return self._pending.pop(int(req_id), None) is not None
+
+    # -- pump (engine-owner context) -------------------------------------
+    def pump(self) -> int:
+        """Admit every request whose KV has arrived (EDF order), and —
+        once the channel is down — cold-admit everything still
+        pending.  Called from the thread that owns the engine (the
+        gateway pump / the test harness).  Returns admissions."""
+        batch: List[dict] = []
+        while True:
+            try:
+                batch.append(self._arrived.get_nowait())
+            except queue.Empty:
+                break
+        if self._closed.is_set():
+            # Dead tier: every parked request degrades to local cold
+            # prefill NOW — parked-forever is the one unacceptable
+            # outcome.
+            with self._lock:
+                orphans = sorted(self._pending)
+            batch.extend({"req": rid, "pages": []} for rid in orphans)
+        def _edf(p: dict) -> Tuple[int, int, int]:
+            with self._lock:
+                ent = self._pending.get(int(p["req"]))
+            dl = None if ent is None else ent["deadline"]
+            return (0, int(dl), int(p["req"])) if dl is not None \
+                else (1, 0, int(p["req"]))
+        admitted = 0
+        for payload in sorted(batch, key=_edf):
+            admitted += self._admit(payload)
+        return admitted
+
+    def _admit(self, payload: dict) -> int:
+        rid = int(payload["req"])
+        with self._lock:
+            entry = self._pending.pop(rid, None)
+        if entry is None:
+            return 0  # cancelled while in flight, or duplicate PAGES
+        injected = 0
+        try:
+            # Chaos boundary: the whole injection is one fault point —
+            # a kv.handoff fault skips it and the request cold-admits,
+            # bit-identically.
+            fault_point("kv.handoff")
+            injected = self._inject(payload.get("pages") or [])
+        except InjectedFault:
+            self.stats["fallbacks"] += 1
+            obs.instant("kv.handoff_dropped", req=rid)
+        if not self._closed.is_set():
+            try:
+                self.chan.send_frame(FRAME_KV_ACK,
+                                     {"req": rid, "injected": injected})
+            except (ConnectionError, TimeoutError, OSError):
+                self._closed.set()
+        try:
+            self.engine.submit(rid, entry["ids"], **entry["kw"])
+        except (EngineOverloaded, ValueError) as e:
+            self.stats["sheds"] += 1
+            if self.on_shed is None:
+                raise
+            self.on_shed(rid, e)
+            return 0
+        self.stats["handoffs"] += 1
+        self.stats["pages_injected"] += injected
+        if obs.get_tracer().enabled:
+            obs.instant("kv.handoff", req=rid, pages=injected)
+        return 1
+
+    def _inject(self, pages: List[Tuple[int, Any]]) -> int:
+        """Insert the shipped (hash, KV) chain into the decode
+        engine's device cache — same discipline as the host-RAM tier's
+        re-admit: chain order, genuinely free pages only (never evict
+        a warmer cached page for a handoff), one batched upload for
+        the whole staged chain."""
+        eng = self.engine
+        staged = []
+        for h, layers in pages:
+            if eng.sched.cache_lookup(int(h)) >= 0:
+                continue  # already resident (an earlier request won)
+            if eng.sched.free_pages < 1:
+                break
+            page = eng.sched.insert_cached(int(h))
+            if page < 0:
+                break
+            staged.append((page, layers))
+        if staged:
+            eng._upload_pages([page for page, _ in staged],
+                              [layers for _, layers in staged])
+        return len(staged)
+
+    @property
+    def pending(self) -> int:
+        with self._lock:
+            return len(self._pending)
+
+    def close(self) -> None:
+        if not self._closed.is_set():
+            try:
+                self.chan.send_frame(FRAME_GOODBYE, {"reason": "done"})
+            except (ConnectionError, TimeoutError, OSError):
+                pass
+        self._closed.set()
+        try:
+            self.chan.close()
+        except OSError:
+            pass
+        self._rx_thread.join(timeout=2.0)
+        self.watchdog.unregister("kv-coord-rx")

@@ -96,7 +96,13 @@ MAGIC = b"ORTP"
 #: channel — the header itself is unchanged, but a v4 peer predates
 #: those kinds and must be rejected at the handshake, not when the
 #: first unknown frame arrives mid-stream.
-PROTOCOL_VERSION = 5
+#: v6: the prefill-tier KV handoff family (FRAME_KV_OFFER /
+#: FRAME_KV_PAGES / FRAME_KV_ACK, defined in
+#: orchestration/prefill_tier.py) joined the channel — again no
+#: header change, but a v5 peer must be turned away at HELLO, not
+#: when a KV_PAGES frame (megabytes of paged KV) lands on a peer
+#: that cannot dispatch it.
+PROTOCOL_VERSION = 6
 
 #: magic(4) + version(u16) + kind(u8) + trace id(u64) + originating
 #: span id(u64) + payload length(u64).  The trace/span ids are 0 when
@@ -115,6 +121,7 @@ _HEADER_HISTORY = {
     3: ">4sHBQ",     # PR 6: magic + version + kind + length
     4: ">4sHBQQQ",   # PR 9: + trace id + span id (distributed tracing)
     5: ">4sHBQQQ",   # PR 12: same header; gateway frame family added
+    6: ">4sHBQQQ",   # PR 17: same header; prefill-tier KV family added
 }
 
 # Frame kinds multiplexed on one channel.
@@ -814,6 +821,32 @@ class WorkerPool:
                                f"({self.heartbeat_timeout:.1f}s)")
             reaped.append(m.wid)
         return reaped
+
+    def retire_member(self, wid: Optional[int] = None) -> Optional[int]:
+        """Graceful scale-down: send GOODBYE to one live member (the
+        NEWEST joiner when ``wid`` is None — last in, first out, so the
+        longest-warmed member keeps serving) and return its wid.  The
+        worker's recv loop sees the GOODBYE, finishes its in-flight
+        batch, and leaves via the normal graceful path — its queued
+        trajectories stay consumable, unlike a kill.  Returns None when
+        no live member exists; a member whose channel is already broken
+        is marked dead instead (the retire still "succeeded" in the
+        sense that the pool shrank)."""
+        with self._lock:
+            live = [m for m in self._members.values() if m.alive]
+            if wid is not None:
+                live = [m for m in live if m.wid == wid]
+            if not live:
+                return None
+            member = max(live, key=lambda m: m.wid)
+        try:
+            member.chan.send_frame(FRAME_GOODBYE,
+                                   {"reason": "scale-down"})
+        except (ConnectionError, TimeoutError, OSError) as e:
+            self._mark_dead(member, f"retire send failed: {e!r}")
+            return member.wid
+        self._event("worker-retire", member.wid)
+        return member.wid
 
     # -- weight fan-out -------------------------------------------------
     def broadcast(self, params_host: Any, version: int) -> int:

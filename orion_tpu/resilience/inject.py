@@ -44,6 +44,8 @@ FAULT_POINTS = frozenset({
     "worker.traj",        # pool worker trajectory send
     "worker.spawn",       # launch.py / autopilot worker-process spawn
     "controller.decide",  # SLO autopilot decision tick
+    "kv.spill",           # device->host KV tier spill of an evicted page
+    "kv.handoff",         # prefill-tier KV page injection on the decode side
 })
 
 

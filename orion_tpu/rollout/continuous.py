@@ -79,13 +79,27 @@ PR 12 made the service MULTI-TENANT and STREAMING:
   decoding: pages freed via the preemption machinery; mid-chunked-
   prefill: deferred one wave to the activation boundary).
 
+PR 17 made the prefix cache TIERED: when the device pool LRU-evicts an
+unreferenced cached page, the scheduler records a (hash, page) event
+and the engine copies that page's KV into a host-RAM tier
+(:class:`~orion_tpu.rollout.host_cache.HostKVCache`, byte-budgeted by
+``cfg.host_cache_bytes``) BEFORE the next pool-donating dispatch can
+overwrite it; a later ``submit`` whose chain hashes miss the device
+cache but hit host re-admits the page device-side (one pool upload)
+and its prefill skips exactly as a device hit would — bit-identical KV
+by hash construction, so tokens and logprobs match the cold path.
+Both tiers flush together on weight reload.  ``submit(...,
+logprobs=True)`` additionally streams per-token sampling logprobs in
+every :class:`StreamChunk`, riding the same lagged snapshot as the
+streamed tokens.
+
 Flow per wave (one ``step()``):
-  apply deferred cancels -> admit -> chunk-prefill admitted/partial
-  prompts (final chunks sample their first token) -> extend in-flight
-  reservations (preempting if dry) -> decode segment of K tokens OR
-  speculative verify segment (jitted) -> harvest finished slots (one
-  wave lagged), free their pages, emit stream chunks, return
-  completions.
+  apply deferred cancels -> admit -> spill evicted pages to host ->
+  chunk-prefill admitted/partial prompts (final chunks sample their
+  first token) -> extend in-flight reservations (preempting if dry)
+  -> spill again -> decode segment of K tokens OR speculative verify
+  segment (jitted) -> harvest finished slots (one wave lagged), free
+  their pages, emit stream chunks, return completions.
 """
 
 from __future__ import annotations
@@ -116,6 +130,24 @@ from orion_tpu.runtime import Scheduler
 _EMPTY, _PREFILL, _DECODE = 0, 1, 2
 
 
+# Host-tier page movement (PR 17): spill/re-admit/handoff batches move
+# many pages at once, and an eager per-page `pool[page]` read or
+# `.at[page].set` write costs one dispatch PER layer-key — at CPU/TPU
+# dispatch latency that overhead alone can exceed the prefill the tier
+# skips.  One jitted program per direction keeps any batch at a single
+# dispatch; callers pad the index vector to a power of two so the
+# compiled-program space stays a handful of buckets.
+@jax.jit
+def _gather_pages(pools, idx):
+    return [{k: v[idx] for k, v in p.items()} for p in pools]
+
+
+@jax.jit
+def _scatter_pages(pools, idx, rows):
+    return [{k: v.at[idx].set(rows[i][k]) for k, v in p.items()}
+            for i, p in enumerate(pools)]
+
+
 @dataclasses.dataclass
 class CompletedRequest:
     req_id: int
@@ -134,13 +166,19 @@ class StreamChunk:
     chunk restarts the stream from completion position 0.  The final
     chunk has ``done=True`` and carries the full
     :class:`CompletedRequest` (tokens + logprobs), which is bit-exact
-    against what ``generate()`` returns for the same seed."""
+    against what ``generate()`` returns for the same seed.
+
+    ``logprobs`` (PR 17): for requests submitted with
+    ``logprobs=True``, the sampling-dist logprob of each token in
+    ``tokens`` (same length, same order, bit-exact against the
+    completed record's ``logprobs``); None otherwise."""
 
     req_id: int
     tokens: np.ndarray
     done: bool = False
     restarted: bool = False
     completed: Optional[CompletedRequest] = None
+    logprobs: Optional[np.ndarray] = None
 
 
 class EngineOverloaded(RuntimeError):
@@ -215,6 +253,25 @@ class ContinuousBatchingEngine:
                 "continuous engine: repetition_penalty != 1.0 disables "
                 "prefix_cache and chunked_prefill_tokens (the penalty's "
                 "seen-set needs the full prompt forward)", stacklevel=2)
+        # Host-RAM KV tier (PR 17): spill LRU-evicted prefix-cache
+        # pages instead of dropping them.  Rides the device prefix
+        # cache's hash machinery, so it is only meaningful (and only
+        # armed) when that cache is on — degrade loudly, never
+        # silently.
+        self._host_cache = None
+        if cfg.host_cache_bytes > 0:
+            if self._prefix_cache_on:
+                from orion_tpu.rollout.host_cache import HostKVCache
+
+                self._host_cache = HostKVCache(cfg.host_cache_bytes)
+            else:
+                import warnings
+
+                warnings.warn(
+                    "continuous engine: host_cache_bytes ignored — the "
+                    "host KV tier requires the prefix cache "
+                    "(prefix_cache=True, repetition_penalty=1.0)",
+                    stacklevel=2)
         # Sharded engine (VERDICT r3 missing #2): with a mesh, the
         # decode twin's params shard via the standard tensor rules, the
         # paged pools shard over kv-heads on the tensor axis, and the
@@ -492,8 +549,14 @@ class ContinuousBatchingEngine:
             out = self._jit_prep(params)
         self._prep_src = params
         self._prep_out = out
-        # Cached prefix KV is weight-dependent: new weights, new cache.
+        # Cached prefix KV is weight-dependent: new weights, new cache
+        # — BOTH tiers, plus any undrained eviction events (their
+        # pages hold old-weights KV that must never spill under a
+        # still-matching hash).
         self.sched.clear_cache()
+        self.sched.drain_evictions()
+        if self._host_cache is not None:
+            self._host_cache.clear()
         return out
 
     def load_weights(self, params) -> None:
@@ -529,6 +592,124 @@ class ContinuousBatchingEngine:
                 digest_size=8).digest()
             out.append(int.from_bytes(h, "little") & ((1 << 63) - 1))
         return tuple(out)
+
+    # -- host-RAM KV tier (PR 17) ---------------------------------------
+    def _fetch_pages(self, pages):
+        """Copy the device KV of ``pages`` to host numpy arrays — ONE
+        jitted gather dispatch + ONE device transfer for the whole
+        batch, however many pages (eager per-page indexing costs a
+        ~0.5ms dispatch per layer-key, which multiplied by a spill
+        batch is more than the prefill the tier exists to skip).  Page
+        counts pad to the next power of two so the gather program
+        space stays a handful of buckets.  Must run BEFORE any
+        pool-donating dispatch in the same wave: an eviction event's
+        page is only intact until the next pool write.  Returns one
+        per-page list of per-layer ``{key: array}`` dicts."""
+        n = len(pages)
+        idx = np.asarray(pages, np.int32)
+        pad = 1
+        while pad < n:
+            pad *= 2
+        if pad > n:
+            idx = np.concatenate([idx, np.full(pad - n, idx[-1],
+                                               np.int32)])
+        rows = jax.device_get(_gather_pages(self._pools,
+                                            jnp.asarray(idx)))
+        return [[{k: np.asarray(v[i]) for k, v in layer.items()}
+                 for layer in rows] for i in range(n)]
+
+    def _fetch_page(self, page: int):
+        return self._fetch_pages([page])[0]
+
+    def _upload_pages(self, pages, rows) -> None:
+        """Write host-tier KV back into the device pools at ``pages``
+        (``rows[i]`` is the per-layer dict list for ``pages[i]``) —
+        ONE jitted scatter dispatch for the whole batch, padded to a
+        power of two by repeating the last page (duplicate scatter
+        indices carry identical rows, so the repeat is a no-op).
+        Runs IMMEDIATELY after the ``insert_cached`` calls that staged
+        these pages — deferring past the next allocation would let an
+        eviction of one of them re-spill whatever garbage the pool
+        held there."""
+        n = len(pages)
+        idx = list(pages)
+        stack = list(rows)
+        while len(idx) & (len(idx) - 1):
+            idx.append(idx[-1])
+            stack.append(stack[-1])
+        batch = [{k: jnp.asarray(np.stack([r[i][k] for r in stack]))
+                  for k in stack[0][i]}
+                 for i in range(len(self._pools))]
+        self._pools = _scatter_pages(
+            self._pools, jnp.asarray(np.asarray(idx, np.int32)), batch)
+
+    def _upload_page(self, page: int, layers) -> None:
+        self._upload_pages([page], [layers])
+
+    def _drain_spills(self) -> None:
+        """Drain the scheduler's pending LRU-eviction events and spill
+        each evicted page's KV to the host tier.  Called right after
+        the allocating phases of a wave (admission, extension) and
+        before the next donating dispatch.  With the tier off the
+        events are drained and discarded (the buffer must never grow
+        unbounded).  A ``kv.spill`` fault drops that one spill — a
+        degraded-but-correct outcome (the next hit re-prefills)."""
+        events = self.sched.drain_evictions()
+        hc = self._host_cache
+        if not events or hc is None:
+            return
+        from orion_tpu.resilience import fault_point
+        from orion_tpu.resilience.inject import InjectedFault
+
+        keep = []
+        for h, page in events:
+            try:
+                fault_point("kv.spill")
+            except InjectedFault:
+                continue
+            keep.append((h, page))
+        if keep:
+            rows = self._fetch_pages([page for _, page in keep])
+            for (h, _), data in zip(keep, rows):
+                hc.put(h, data)
+        obs.instant("kv.spill_batch", pages=len(events),
+                    host_entries=len(hc))
+
+    def _readmit_from_host(self, hashes) -> None:
+        """Promote the longest host-tier-resident prefix of ``hashes``
+        back into the device cache so the upcoming admission's cached-
+        matching loop hits it.  Chain order only — a later page's KV is
+        meaningless without every earlier one device-resident.  Inserts
+        go into genuinely FREE pages only (churn guard: re-admission
+        must never evict warmer device-cached pages), and the whole
+        staged chain uploads in ONE batched dispatch before this
+        returns — i.e. before any later allocation could evict one of
+        the staged pages and re-spill garbage."""
+        hc = self._host_cache
+        staged = []
+        for h in hashes:
+            if self.sched.cache_lookup(h) >= 0:
+                continue  # already device-cached: nothing to upload
+            if self.sched.free_pages < 1:
+                break
+            data = hc.get(h)
+            if data is None:
+                break  # chain broken: later hashes cannot hit either
+            page = self.sched.insert_cached(h)
+            if page < 0:
+                break
+            staged.append((h, page, data))
+        if not staged:
+            return
+        self._upload_pages([page for _, page, _ in staged],
+                           [data for _, _, data in staged])
+        for h, page, _ in staged:
+            # Promoted device-side: drop the host copy (it re-spills
+            # on its next device eviction) so one page's KV is never
+            # double-resident against the byte budget.
+            hc.pop(h)
+            hc.readmits += 1
+            obs.instant("kv.readmit", page=page)
 
     def _match_windows(self, seq, ln):
         """[S, n_win] bool: window starts whose n-gram equals each
@@ -1238,7 +1419,8 @@ class ContinuousBatchingEngine:
     def submit(self, req_id: int, ids, budget: Optional[int] = None,
                k: int = 1, priority: int = 0,
                deadline: Optional[int] = None, tenant="default",
-               stream: bool = False, on_tokens=None) -> None:
+               stream: bool = False, on_tokens=None,
+               logprobs: bool = False) -> None:
         """Enqueue a request (or a k-clone sampling group with ids
         req_id .. req_id+k-1).  budget ≤ cfg.max_new_tokens caps the
         completion; priority/deadline feed the scheduler's admission
@@ -1246,11 +1428,13 @@ class ContinuousBatchingEngine:
         (weighted-fair admission + the configure_tenant limits).
         ``stream=True`` delivers completion tokens incrementally via
         ``poll(req_id)``, or pushes them through ``on_tokens(chunk)``
-        from inside ``step()`` when a callback is given.  Completions
-        come back from later ``step()`` calls in finish order either
-        way.  Raises :class:`EngineOverloaded` when a QoS gate refuses
-        admission (nothing is enqueued — the caller may retry after
-        ``retry_after``)."""
+        from inside ``step()`` when a callback is given; with
+        ``logprobs=True`` each chunk also carries the per-token
+        sampling logprobs (PR 17 — bit-exact against the completed
+        record).  Completions come back from later ``step()`` calls in
+        finish order either way.  Raises :class:`EngineOverloaded`
+        when a QoS gate refuses admission (nothing is enqueued — the
+        caller may retry after ``retry_after``)."""
         cfg = self.cfg
         ids = np.asarray(ids, np.int32)
         budget = int(cfg.max_new_tokens if budget is None else budget)
@@ -1308,6 +1492,8 @@ class ContinuousBatchingEngine:
                       else None)
         dl = -1 if deadline is None else int(deadline)
         hashes = self._page_hashes(ids)
+        if self._host_cache is not None and hashes:
+            self._readmit_from_host(hashes)
         if k > 1:
             self.sched.add_group(req_id, len(ids), budget, k,
                                  priority=priority, deadline=dl,
@@ -1323,7 +1509,8 @@ class ContinuousBatchingEngine:
             if stream:
                 self._streams[req_id + j] = {
                     "emitted": 0, "chunks": [], "restarted": False,
-                    "done": False, "completed": None, "cb": on_tokens}
+                    "done": False, "completed": None, "cb": on_tokens,
+                    "lp": bool(logprobs), "lp_chunks": []}
             if slo_tenant is not None:
                 self.telemetry.mark(req_id + j, "submit",
                                     prompt_len=len(ids), budget=budget,
@@ -1372,6 +1559,7 @@ class ContinuousBatchingEngine:
         if st is not None:
             st["emitted"] = 0
             st["chunks"] = []
+            st["lp_chunks"] = []
             st["restarted"] = True
         if count:
             self.preemptions += 1
@@ -1456,10 +1644,15 @@ class ContinuousBatchingEngine:
             return None
         toks = (np.concatenate(st["chunks"])
                 if st["chunks"] else np.empty(0, np.int32))
+        lps = None
+        if st["lp"]:
+            lps = (np.concatenate(st["lp_chunks"])
+                   if st["lp_chunks"] else np.empty(0, np.float32))
         chunk = StreamChunk(req_id=rid, tokens=toks, done=st["done"],
                             restarted=st["restarted"],
-                            completed=st["completed"])
+                            completed=st["completed"], logprobs=lps)
         st["chunks"] = []
+        st["lp_chunks"] = []
         st["restarted"] = False
         if st["done"]:
             del self._streams[rid]
@@ -1731,6 +1924,11 @@ class ContinuousBatchingEngine:
             else:
                 self._prefilling[head]["slots"][j] = (rid, slot)
 
+        # -- host-tier spill: admission may have LRU-evicted cached
+        #    pages; their KV is still intact ONLY until the prefill
+        #    dispatch below donates the pools ---------------------------
+        self._drain_spills()
+
         # -- prefill (one chunk per wave; final chunks sample) ----------
         if self._prefilling:
             self._rng, sub = jax.random.split(self._rng)
@@ -1743,6 +1941,9 @@ class ContinuousBatchingEngine:
 
         # -- on-demand reservation growth (may preempt) -----------------
         self._extend_running(spec_wave)
+        # Extension evictions spill here, before the segment dispatch
+        # below donates the pools.
+        self._drain_spills()
         # Page-pool occupancy at the wave's peak (post-extension):
         # the headroom signal behind watermark/preemption tuning.
         self.telemetry.record_occupancy(
@@ -1796,15 +1997,26 @@ class ContinuousBatchingEngine:
             # fetch's pairing guard — tokens can only ever be emitted
             # for the admission they were decoded under.  Non-streaming
             # traffic pays nothing.
-            stream_live = bool(self._streams) and any(
-                self._phase[s] == _DECODE
-                and int(self._slot_req[s]) in self._streams
-                for s in range(self.slots))
+            stream_live = lp_live = False
+            if self._streams:
+                for s in range(self.slots):
+                    if self._phase[s] != _DECODE:
+                        continue
+                    sst = self._streams.get(int(self._slot_req[s]))
+                    if sst is not None:
+                        stream_live = True
+                        if sst["lp"]:
+                            lp_live = True
+                            break
             snap_in = [self._state["done"], self._state["n_new"]]
             if self._spec:
                 snap_in.append(self._state["spec_counts"])
             if stream_live:
                 snap_in.append(self._state["toks"])
+            if lp_live:
+                # logprob streaming (PR 17): one more [S, T] copy rides
+                # the snapshot only when a live stream asked for it.
+                snap_in.append(self._state["lps"])
             snap = self._jit_snap(*snap_in)
             flags = {"done": snap[0], "n_new": snap[1],
                      "seq": np.where(self._phase == _DECODE,
@@ -1815,6 +2027,9 @@ class ContinuousBatchingEngine:
                 i += 1
             if stream_live:
                 flags["toks"] = snap[i]
+                i += 1
+            if lp_live:
+                flags["lps"] = snap[i]
         else:
             flags = None
 
@@ -1935,8 +2150,10 @@ class ContinuousBatchingEngine:
                     self._EMA_GLOBAL * rate
                     + (1 - self._EMA_GLOBAL) * self._spec_global_ema)
 
-    def _emit_stream_chunks(self, toks_h, n_new_h, snap_seq) -> None:
-        """Route this snapshot's newly decoded tokens to their
+    def _emit_stream_chunks(self, toks_h, n_new_h, snap_seq,
+                            lps_h=None) -> None:
+        """Route this snapshot's newly decoded tokens (and, for
+        ``logprobs=True`` streams, their sampling logprobs) to their
         streaming requests (buffered for ``poll``, or pushed through
         the submit-time callback).  Guarded by the same admission-seq
         pairing as the done flags: a slot's tokens only ever stream to
@@ -1949,19 +2166,26 @@ class ContinuousBatchingEngine:
             if st is None:
                 continue
             n = int(n_new_h[s])
-            if n <= st["emitted"]:
+            lo = st["emitted"]
+            if n <= lo:
                 continue
-            new = np.asarray(toks_h[s, st["emitted"]:n], np.int32).copy()
+            new = np.asarray(toks_h[s, lo:n], np.int32).copy()
+            new_lp = None
+            if st["lp"] and lps_h is not None:
+                new_lp = np.asarray(lps_h[s, lo:n], np.float32).copy()
             st["emitted"] = n
             if st["cb"] is not None:
                 restarted = st["restarted"]
                 st["restarted"] = False
                 st["cb"](StreamChunk(req_id=rid, tokens=new,
-                                     restarted=restarted))
+                                     restarted=restarted,
+                                     logprobs=new_lp))
             else:
                 st["chunks"].append(new)
+                if new_lp is not None:
+                    st["lp_chunks"].append(new_lp)
 
-    def _finish_stream(self, rid: int, rows_t, n: int,
+    def _finish_stream(self, rid: int, rows_t, rows_l, n: int,
                        completed: CompletedRequest) -> None:
         """Final stream delivery for a harvested request: whatever the
         per-wave snapshots had not yet emitted, plus the completed
@@ -1969,7 +2193,10 @@ class ContinuousBatchingEngine:
         st = self._streams.get(rid)
         if st is None:
             return
-        tail = np.asarray(rows_t[st["emitted"]:n], np.int32).copy()
+        lo = st["emitted"]
+        tail = np.asarray(rows_t[lo:n], np.int32).copy()
+        tail_lp = (np.asarray(rows_l[lo:n], np.float32).copy()
+                   if st["lp"] else None)
         st["emitted"] = n
         st["done"] = True
         st["completed"] = completed
@@ -1977,10 +2204,12 @@ class ContinuousBatchingEngine:
             restarted = st["restarted"]
             st["cb"](StreamChunk(req_id=rid, tokens=tail, done=True,
                                  restarted=restarted,
-                                 completed=completed))
+                                 completed=completed, logprobs=tail_lp))
             del self._streams[rid]  # pushed: nothing left to poll
         else:
             st["chunks"].append(tail)
+            if tail_lp is not None:
+                st["lp_chunks"].append(tail_lp)
 
     def _harvest_pending(self) -> List[CompletedRequest]:
         """Process the pending snapshot (if any): emit stream chunks,
@@ -1992,7 +2221,8 @@ class ContinuousBatchingEngine:
             return out
         pf = self._pending_flags
         self._pending_flags = None
-        fetch = {k: pf[k] for k in ("done", "n_new", "counts", "toks")
+        fetch = {k: pf[k]
+                 for k in ("done", "n_new", "counts", "toks", "lps")
                  if k in pf}
         fetched = jax.device_get(fetch)
         done_h, n_new_h = fetched["done"], fetched["n_new"]
@@ -2001,7 +2231,8 @@ class ContinuousBatchingEngine:
         if counts_h is not None:
             self._spec_accounting(snap_seq, counts_h)
         if "toks" in fetched:
-            self._emit_stream_chunks(fetched["toks"], n_new_h, snap_seq)
+            self._emit_stream_chunks(fetched["toks"], n_new_h, snap_seq,
+                                     fetched.get("lps"))
         finished = [s for s in range(self.slots)
                     if self._slot_req[s] >= 0
                     and self._phase[s] == _DECODE
@@ -2026,7 +2257,8 @@ class ContinuousBatchingEngine:
                     logprobs=rows_h["l"][s][:n].astype(np.float32),
                     policy_logprobs=rows_h["p"][s][:n].astype(
                         np.float32)))
-                self._finish_stream(rid, rows_h["t"][s], n, out[-1])
+                self._finish_stream(rid, rows_h["t"][s], rows_h["l"][s],
+                                    n, out[-1])
                 self._req_tenant.pop(rid, None)
                 self.sched.finish(rid)
                 self.telemetry.finish(rid, n)
@@ -2069,6 +2301,15 @@ class ContinuousBatchingEngine:
         stats["spec_resampled"] = float(self.spec_resampled)
         stats["spec_accept_ema"] = (float(self._spec_global_ema)
                                     if self._spec else 0.0)
+        # Host-RAM KV tier (PR 17): stable shape — zeros when off.
+        if self._host_cache is not None:
+            stats.update(self._host_cache.stats())
+        else:
+            stats.update({k: 0.0 for k in (
+                "host_cache_entries", "host_cache_bytes",
+                "host_cache_hits", "host_cache_misses",
+                "host_cache_spills", "host_cache_evictions",
+                "host_cache_readmits")})
         return stats
 
     def reset_spec_state(self) -> None:
@@ -2094,6 +2335,10 @@ class ContinuousBatchingEngine:
         self.spec_drafted = 0
         self.spec_accepted = 0
         self.spec_resampled = 0
+        if self._host_cache is not None:
+            # Counters only — resident entries are warm state a bench
+            # window must keep (that warmth is what it measures).
+            self._host_cache.reset_counters()
 
     # -- host driver ----------------------------------------------------
     def generate(self, requests: Iterable[Tuple[int, np.ndarray]],

@@ -1,0 +1,125 @@
+"""Host-RAM KV tier for the paged prefix cache (PR 17, ISSUE 17).
+
+The device page pool is tier 0: PR 8's refcounted prefix cache keeps
+hash-matched full prompt pages resident until LRU pressure evicts them
+at refs==0.  Before this PR an eviction simply discarded the KV and
+the next prefix hit paid a full re-prefill.  :class:`HostKVCache` is
+tier 1: the engine drains the scheduler's eviction events and copies
+each evicted page's KV (one fixed-shape bundle of numpy arrays per
+layer) into a chain-hash-keyed, byte-budgeted LRU dict in host RAM;
+a later ``submit`` whose prompt chain-hashes miss the device cache but
+hit here re-admits the page device-side (``Scheduler.insert_cached`` +
+a single pool upload) and skips the prefill forward for it entirely.
+
+Correctness stance: entries are keyed by the same chain hash the
+device cache uses, so a hit is bit-identical KV by construction, and
+the whole tier is flushed alongside ``clear_cache()`` on weight reload
+(stale-weights KV under a still-matching hash must never survive).
+The cache stores HOST arrays only — it never holds device buffers
+alive across donating dispatches.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Dict, List, Optional
+
+import numpy as np
+
+PageKV = List[Dict[str, np.ndarray]]  # per-layer {"k_pages": ..., ...}
+
+
+def _nbytes(layers: PageKV) -> int:
+    return sum(a.nbytes for d in layers for a in d.values())
+
+
+class HostKVCache:
+    """Byte-budgeted LRU map: chain hash -> one page's per-layer KV.
+
+    ``put`` on an existing hash refreshes recency but keeps the first
+    copy (same hash == same bytes); entries larger than the whole
+    budget are rejected rather than thrashing the tier empty.  Counter
+    fields feed the tier-labelled ``server_stats()`` block.
+    """
+
+    def __init__(self, budget_bytes: int):
+        if budget_bytes <= 0:
+            raise ValueError(
+                f"host cache budget must be > 0 bytes, got {budget_bytes}")
+        self.budget_bytes = int(budget_bytes)
+        self._entries: "OrderedDict[int, PageKV]" = OrderedDict()
+        self._bytes = 0
+        self.hits = 0
+        self.misses = 0
+        self.spills = 0
+        self.evictions = 0
+        self.readmits = 0
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    @property
+    def bytes_used(self) -> int:
+        return self._bytes
+
+    def put(self, h: int, layers: PageKV) -> bool:
+        """Admit one spilled page; returns False when it cannot fit."""
+        if h in self._entries:
+            self._entries.move_to_end(h)
+            return True
+        size = _nbytes(layers)
+        if size > self.budget_bytes:
+            return False
+        self._entries[h] = layers
+        self._bytes += size
+        self.spills += 1
+        while self._bytes > self.budget_bytes:
+            _, old = self._entries.popitem(last=False)
+            self._bytes -= _nbytes(old)
+            self.evictions += 1
+        return True
+
+    def get(self, h: int) -> Optional[PageKV]:
+        """Look up a chain hash, refreshing its LRU recency on hit."""
+        layers = self._entries.get(h)
+        if layers is None:
+            self.misses += 1
+            return None
+        self._entries.move_to_end(h)
+        self.hits += 1
+        return layers
+
+    def pop(self, h: int) -> Optional[PageKV]:
+        """Remove and return an entry (no hit/miss accounting) — the
+        re-admit path uses this so a page promoted back to the device
+        tier is not double-resident in host RAM."""
+        layers = self._entries.pop(h, None)
+        if layers is not None:
+            self._bytes -= _nbytes(layers)
+        return layers
+
+    def reset_counters(self) -> None:
+        """Zero the lifetime counters (bench measurement windows);
+        resident entries stay — their warmth is what a tiered bench
+        pass measures."""
+        self.hits = self.misses = self.spills = 0
+        self.evictions = self.readmits = 0
+
+    def clear(self) -> int:
+        """Flush the tier (weight reload); counters survive — they are
+        lifetime telemetry, not per-epoch state."""
+        n = len(self._entries)
+        self._entries.clear()
+        self._bytes = 0
+        return n
+
+    def stats(self) -> dict:
+        return {
+            "host_cache_entries": float(len(self._entries)),
+            "host_cache_bytes": float(self._bytes),
+            "host_cache_hits": float(self.hits),
+            "host_cache_misses": float(self.misses),
+            "host_cache_spills": float(self.spills),
+            "host_cache_evictions": float(self.evictions),
+            "host_cache_readmits": float(self.readmits),
+        }

@@ -31,6 +31,19 @@
 // divergence page is structural: only bit-identical FULL pages are
 // ever shared, the first divergent page is freshly computed/private.
 //
+// Tiered spill/re-admit hooks (PR 17): every LRU eviction records its
+// (hash, page) pair in an eviction event buffer the engine drains
+// (DrainEvictions) so it can copy the page's KV to a host-RAM tier
+// BEFORE the page is overwritten; a later prefix hit re-admits the
+// hash device-side via InsertCached (allocates a page, registers it
+// refs==0 at the LRU tail — the engine uploads the host KV into it
+// immediately).  The scheduler itself never touches KV bytes: it only
+// reports which page held which hash, keeping both implementations'
+// decision sequences bit-identical (the randomized cross-check drives
+// insert/drain too).  ClearCache does NOT emit eviction events — a
+// weight reload invalidates the host tier wholesale; spilling
+// old-weights KV under still-matching hashes would poison it.
+//
 // Admission policies: FIFO (arrival order, no overtaking), PRIORITY
 // (higher value first, FIFO tiebreak), DEADLINE (EDF, FIFO tiebreak).
 // All decisions are deterministic and bit-identically mirrored by the
@@ -442,6 +455,45 @@ class Scheduler {
     return n;
   }
 
+  // Probe the prefix cache: the device page holding `hash`, or -1.
+  // The engine's host-tier re-admission uses this to skip hashes that
+  // are already device-cached (no upload needed).
+  int CacheLookup(int64_t hash) const {
+    auto it = cache_map_.find(hash);
+    return it == cache_map_.end() ? -1 : it->second;
+  }
+
+  // Re-admit a host-tier hash device-side: allocate a page (may itself
+  // LRU-evict — that eviction is recorded like any other) and register
+  // it as a refs==0 cached page at the LRU tail.  Returns the page
+  // index (the engine must upload the host KV into it BEFORE any other
+  // dispatch), -2 when the hash is already device-cached, -1 when the
+  // pool has no page to give.
+  int InsertCached(int64_t hash) {
+    if (cache_map_.count(hash)) return -2;
+    if (AvailablePages() < 1) return -1;
+    int32_t p = AllocPage();
+    cache_map_.emplace(hash, p);
+    cached_pages_.emplace(p, CachedPage{hash, 0, false});
+    avail_.push_back(p);
+    return p;
+  }
+
+  // Drain up to `cap` pending (hash, page) eviction events in the
+  // order they occurred, removing the drained prefix.  Returns the
+  // count copied; the caller loops until 0 (events past `cap` stay
+  // queued, never lost).
+  int DrainEvictions(int64_t* out_hashes, int32_t* out_pages, int cap) {
+    int n = static_cast<int>(evictions_.size());
+    if (n > cap) n = cap;
+    for (int i = 0; i < n; ++i) {
+      out_hashes[i] = evictions_[i].first;
+      out_pages[i] = evictions_[i].second;
+    }
+    evictions_.erase(evictions_.begin(), evictions_.begin() + n);
+    return n;
+  }
+
   int FreePages() const { return static_cast<int>(free_pages_.size()); }
   int AvailablePages() const {
     return static_cast<int>(free_pages_.size() + avail_.size());
@@ -542,6 +594,11 @@ class Scheduler {
 
   // Pop a free page, evicting the LRU unreferenced cached page when
   // the free list is empty.  Caller must have checked AvailablePages.
+  // An eviction is recorded as a (hash, page) event for the engine's
+  // host-tier spill: the KV is still intact on the device page until
+  // the engine dispatches the next write, so draining promptly after
+  // the allocating call (Admit/Extend/InsertCached) lets it copy the
+  // bytes out in time.
   int32_t AllocPage() {
     if (!free_pages_.empty()) {
       int32_t p = free_pages_.back();
@@ -550,6 +607,7 @@ class Scheduler {
     }
     int32_t p = avail_.front();
     avail_.pop_front();
+    evictions_.emplace_back(cached_pages_.at(p).hash, p);
     cache_map_.erase(cached_pages_.at(p).hash);
     cached_pages_.erase(p);
     return p;
@@ -611,6 +669,9 @@ class Scheduler {
   std::unordered_map<int64_t, int32_t> cache_map_;     // hash -> page
   std::unordered_map<int32_t, CachedPage> cached_pages_;
   std::list<int32_t> avail_;  // refs==0 cached pages, LRU front-first
+  // Pending LRU-eviction events (hash, page), oldest first, cleared
+  // by DrainEvictions (the engine's host-tier spill feed).
+  std::vector<std::pair<int64_t, int32_t>> evictions_;
 };
 
 }  // namespace
@@ -690,6 +751,20 @@ int osch_finish(void* h, int64_t id) {
 
 int osch_clear_cache(void* h) {
   return static_cast<Scheduler*>(h)->ClearCache();
+}
+
+int osch_cache_lookup(void* h, int64_t hash) {
+  return static_cast<Scheduler*>(h)->CacheLookup(hash);
+}
+
+int osch_insert_cached(void* h, int64_t hash) {
+  return static_cast<Scheduler*>(h)->InsertCached(hash);
+}
+
+int osch_drain_evictions(void* h, int64_t* out_hashes, int32_t* out_pages,
+                         int cap) {
+  return static_cast<Scheduler*>(h)->DrainEvictions(out_hashes, out_pages,
+                                                    cap);
 }
 
 int osch_free_pages(void* h) {

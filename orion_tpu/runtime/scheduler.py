@@ -30,6 +30,14 @@ tenant — register envelopes via ``set_tenant(tenant, weight,
 max_running)``.  One uncapped tenant degrades exactly to the
 single-queue order.  ``cancel`` removes a waiting request (the
 engine's abort path).
+
+PR 17 (tiered KV cache): every LRU eviction of a refs==0 cached page
+is recorded as a (hash, page) event for ``drain_evictions`` — the
+engine's hook for spilling the page's KV to a host-RAM tier before the
+page is overwritten — and ``insert_cached(hash)`` re-admits a
+host-tier hash device-side (``cache_lookup`` probes for it first).
+The scheduler never touches KV bytes, so both implementations stay
+bit-identical.
 """
 
 from __future__ import annotations
@@ -179,6 +187,13 @@ def _bind(so: Optional[str]):
     lib.osch_extend.restype = ctypes.c_int
     lib.osch_extend.argtypes = [ctypes.c_void_p, ctypes.c_int64,
                                 ctypes.c_int, ctypes.c_int]
+    for name in ("osch_cache_lookup", "osch_insert_cached"):
+        fn = getattr(lib, name)
+        fn.restype = ctypes.c_int
+        fn.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+    lib.osch_drain_evictions.restype = ctypes.c_int
+    lib.osch_drain_evictions.argtypes = [ctypes.c_void_p, i64p, i32p,
+                                         ctypes.c_int]
     for name in ("osch_slot", "osch_shared_count", "osch_cached_count",
                  "osch_preempt", "osch_finish"):
         fn = getattr(lib, name)
@@ -217,6 +232,9 @@ class _NativeScheduler:
         # Reused across pages() calls: a fresh 256 KB ctypes buffer per
         # call showed up at ~4 ms/wave in the serving-loop profile.
         self._pages_buf = (ctypes.c_int32 * (1 << 16))()
+        # Reused drain_evictions buffers (same rationale).
+        self._evh_buf = (ctypes.c_int64 * 4096)()
+        self._evp_buf = (ctypes.c_int32 * 4096)()
 
     def __del__(self):
         if getattr(self, "_h", None):
@@ -320,6 +338,31 @@ class _NativeScheduler:
     def clear_cache(self) -> int:
         return self._lib.osch_clear_cache(self._h)
 
+    def cache_lookup(self, h: int) -> int:
+        """Device page currently caching chain-hash ``h``, or -1."""
+        return self._lib.osch_cache_lookup(self._h, h)
+
+    def insert_cached(self, h: int) -> int:
+        """Re-admit host-tier hash ``h`` device-side as a refs==0
+        cached page (LRU tail).  Returns the allocated page (upload the
+        host KV into it before any other dispatch), -2 when already
+        device-cached, -1 when no page is available."""
+        return self._lib.osch_insert_cached(self._h, h)
+
+    def drain_evictions(self) -> List[Tuple[int, int]]:
+        """Pending (hash, page) LRU-eviction events in occurrence
+        order; draining clears them.  Call promptly after any
+        allocating operation — the KV is only intact until the engine's
+        next pool write."""
+        out: List[Tuple[int, int]] = []
+        while True:
+            n = self._lib.osch_drain_evictions(self._h, self._evh_buf,
+                                               self._evp_buf, 4096)
+            out.extend((int(self._evh_buf[i]), int(self._evp_buf[i]))
+                       for i in range(n))
+            if n < 4096:
+                return out
+
     @property
     def free_pages(self) -> int:
         return self._lib.osch_free_pages(self._h)
@@ -371,6 +414,7 @@ class PyScheduler:
         self._avail: list = []         # refs==0 cached pages, LRU order
         self._tenants: dict = {}       # tenant -> [weight, vserv]
         self._vclock = 0               # last admission's service level
+        self._evictions: list = []     # (hash, page) LRU spill events
         self.max_slots = max_slots
 
     _VSCALE = 4096  # integer virtual-service scale (mirror of kVScale)
@@ -457,6 +501,7 @@ class PyScheduler:
         if self._free_pages:
             return self._free_pages.pop()
         page = self._avail.pop(0)  # evict LRU unreferenced cached page
+        self._evictions.append((self._cached_pages[page][0], page))
         del self._cache_map[self._cached_pages[page][0]]
         del self._cached_pages[page]
         return page
@@ -698,6 +743,34 @@ class PyScheduler:
                 del self._cache_map[ent[0]]
                 ent[2] = True
         return n
+
+    def cache_lookup(self, h: int) -> int:
+        """Device page currently caching chain-hash ``h``, or -1."""
+        return self._cache_map.get(h, -1)
+
+    def insert_cached(self, h: int) -> int:
+        """Re-admit host-tier hash ``h`` device-side as a refs==0
+        cached page (LRU tail).  Returns the allocated page (upload the
+        host KV into it before any other dispatch), -2 when already
+        device-cached, -1 when no page is available."""
+        if h in self._cache_map:
+            return -2
+        if self._available() < 1:
+            return -1
+        page = self._alloc_page()
+        self._cache_map[h] = page
+        self._cached_pages[page] = [h, 0, False]
+        self._avail.append(page)
+        return page
+
+    def drain_evictions(self) -> List[Tuple[int, int]]:
+        """Pending (hash, page) LRU-eviction events in occurrence
+        order; draining clears them.  Call promptly after any
+        allocating operation — the KV is only intact until the engine's
+        next pool write."""
+        out = self._evictions
+        self._evictions = []
+        return out
 
     @property
     def free_pages(self) -> int:

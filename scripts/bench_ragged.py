@@ -270,6 +270,128 @@ def run_spec_arms(sh, seed, reps=3):
     return out
 
 
+def run_tiered_arm(sh, seed, reps=3):
+    """Tiered KV prefix-cache A/B (ISSUE 17 tentpole (a)): a working
+    set of warm target prompts is churned out of a deliberately small
+    device pool by filler bursts, then revisited.
+
+    The fillers are shaped to be maximally hostile to the DEVICE cache
+    while staying invisible to the host tier: a one-page prompt
+    graduates ZERO cached pages (cacheable pages are capped at
+    ``(plen-1)//page_size``), so a filler pollutes nothing — but its
+    decode reservation is large, so admitting a filler burst LRU-evicts
+    the target's cached pages.  LRU evicts in graduation = CHAIN order,
+    so what dies first is the chain HEAD — and longest-prefix matching
+    makes a missing head worth exactly nothing to the tier-off arm: it
+    re-prefills the full prompt.  The tiered arm re-admits the spilled
+    head pages from host RAM at submit (into genuinely free pages, of
+    which the fillers' completions just released plenty) and serves the
+    whole chain as a prefix hit.  Same requests, same weights, same
+    decode work — the delta is re-prefill vs re-admit, which is the
+    tier's whole value proposition.  Walls are per-rep PAIRED (tier-off
+    and tier-on back to back on identical cycles) and best-of-``reps``
+    by ratio, the repo's bench-noise rule."""
+    from orion_tpu.config import ModelConfig, RolloutConfig
+    from orion_tpu.models import Transformer, init_params
+    from orion_tpu.rollout.continuous import ContinuousBatchingEngine
+
+    if sh["model"] == "tiny":
+        # The 2-layer tiny's prefill forward is cheaper than the
+        # host<->device page copies the tier spends to SKIP it — the
+        # same reason the spec arms run a deeper model: the arm
+        # measures a decode-path trade (re-prefill vs re-admit), so
+        # the prefill must cost something.  4L/256H at a 96-token
+        # prompt is still a sub-minute CPU arm.
+        mc = ModelConfig.tiny(num_layers=4, hidden_size=256,
+                              intermediate_size=512, dtype="float32")
+        quant = False
+        P, ps, seg = 96, 8, 8
+    else:
+        mc = ModelConfig.pythia_1b()
+        mc.max_seq_len = sh["P"] + sh["T"]
+        mc.scan_layers = True
+        quant = True
+        P, ps, seg = sh["P"], sh["page_size"], sh["seg"]
+    model = Transformer(mc)
+    params = init_params(model, jax.random.key(0), mc)
+    per_prompt = (P - 1) // ps          # cacheable pages per target
+    n_fill, fill_budget = 4, 2 * seg
+    fill_active = -(-(ps + fill_budget) // ps)
+    # Pool sizing: a filler burst must overflow the free pages left
+    # beside one warm target (forcing >= 3 chain-head evictions), and
+    # the burst's completions must free enough pages for a full
+    # re-admit at the next target submit.
+    num_pages = n_fill * fill_active + per_prompt - 3
+    n_targets, cycles = 3, 6
+
+    def mk(host_bytes):
+        eng = ContinuousBatchingEngine(
+            model, mc, RolloutConfig(
+                max_prompt_len=P, max_new_tokens=fill_budget,
+                temperature=0.0, quantize_weights=quant,
+                max_batch_size=n_fill, page_size=ps, segment_len=seg,
+                prefix_cache=True, num_pages=num_pages,
+                page_watermark=0, host_cache_bytes=host_bytes),
+            eos_token_id=None, pad_token_id=0)
+        eng.load_weights(params)
+        return eng
+
+    rs = np.random.RandomState(seed + 13)
+    targets = [rs.randint(2, 200, P).astype(np.int32)
+               for _ in range(n_targets)]
+
+    def drain(eng):
+        waves = 0
+        while eng.pending:
+            eng.step()
+            waves += 1
+            assert waves < 100000
+
+    def block(eng, rid0, frs):
+        """One churn block: `cycles` rounds of (revisit one target,
+        then a filler burst); fillers are fresh random every cycle so
+        only the targets ever re-hit."""
+        rid = rid0
+        for c in range(cycles):
+            eng.submit(rid, targets[c % n_targets], budget=seg)
+            rid += 1
+            drain(eng)
+            for _ in range(n_fill):
+                eng.submit(rid, frs.randint(2, 200, ps)
+                           .astype(np.int32), budget=fill_budget)
+                rid += 1
+            drain(eng)
+        return rid
+
+    def timed(eng, rep):
+        frs = np.random.RandomState(seed + 100 * rep)
+        eng.reset_rng(jax.random.key(31))
+        rid = block(eng, 10**6 * rep, frs)          # warm: compile +
+        t0 = time.perf_counter()                    # cold cache fills
+        block(eng, rid, frs)
+        return time.perf_counter() - t0  # orion: ignore[bench-no-block, naked-timer] drain() fetched every completion host-side; the wall window IS the metric
+
+    off, on = mk(0), mk(1 << 28)
+    tot = float(cycles * (seg + n_fill * fill_budget))
+    best = None
+    for rep in range(1, reps + 1):
+        w_off = timed(off, rep)
+        w_on = timed(on, rep)
+        ratio = w_off / w_on
+        if best is None or ratio > best[2]:
+            best = (w_off, w_on, ratio)
+    hc = on._host_cache
+    return {
+        "tiered_cache_toks_per_sec": round(tot / best[1], 1),
+        "tiered_off_toks_per_sec": round(tot / best[0], 1),
+        "tiered_speedup": round(best[2], 3),
+        "tiered_host_hit_rate": round(
+            hc.hits / max(hc.hits + hc.misses, 1), 3),
+        "tiered_host_spills": hc.spills,
+        "tiered_host_readmits": hc.readmits,
+    }
+
+
 def _spawn_bench_worker(port, rank, workers):
     """In-process stand-in for a rollout worker: a thread speaking the
     real TCP pool protocol through PoolWorkerClient.  The autopilot
@@ -837,6 +959,10 @@ def run(sh=None, seed=None, record=True):
     # random-prompt adaptive-k overhead, in the same JSON line.
     out.update(run_spec_arms(sh, seed))
 
+    # Tiered KV prefix cache A/B (ISSUE 17): churn-then-revisit on a
+    # small pool — host-RAM re-admit vs full re-prefill.
+    out.update(run_tiered_arm(sh, seed))
+
     # Closed-loop SLO autopilot (PR 13): chaos-vs-uncontended
     # paid-tenant TTFT with the controller active, tiny shape always.
     out.update(run_autopilot_arm(seed))
@@ -848,6 +974,7 @@ def run(sh=None, seed=None, record=True):
         spec_key = f"ragged_spec_toks_per_sec_{sh['model']}"
         spec_oh_key = f"ragged_spec_overhead_pct_{sh['model']}"
         stream_key = f"streaming_ttft_p95_{sh['model']}"
+        tier_key = f"ragged_tiered_cache_toks_per_sec_{sh['model']}"
         auto_key = "autopilot_p95_recovery_tiny"
         base = {}
         if os.path.exists(self_path):
@@ -879,6 +1006,14 @@ def run(sh=None, seed=None, record=True):
             # finish-at-end p95 in the same runs.
             base[stream_key] = out["streaming_ttft_p95"]
             changed = True
+        if tier_key not in base:
+            # Tiered-KV regression row (ISSUE 17; higher is better):
+            # churn-then-revisit tok/s with the host-RAM tier on,
+            # paired best-of-3 against the tier-off arm in the same
+            # runs (the paired ratio rides the JSON line as
+            # ``tiered_speedup``, acceptance bound > 1.0).
+            base[tier_key] = out["tiered_cache_toks_per_sec"]
+            changed = True
         if auto_key not in base:
             # SLO-autopilot regression row (PR 13; lower is better):
             # paid-tenant chaos/uncontended TTFT p95 ratio with the
@@ -901,6 +1036,9 @@ def run(sh=None, seed=None, record=True):
         out["streaming_ttft_vs_baseline"] = \
             round(out["streaming_ttft_p95"] / base[stream_key], 4) \
             if base.get(stream_key) else 1.0
+        out["tiered_vs_baseline"] = \
+            round(out["tiered_cache_toks_per_sec"] / base[tier_key], 4) \
+            if base.get(tier_key) else 1.0
         out["autopilot_recovery_vs_baseline"] = \
             round(out["autopilot_p95_recovery"] / base[auto_key], 4) \
             if base.get(auto_key) else 1.0

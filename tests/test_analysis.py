@@ -682,6 +682,58 @@ FIXTURES = [
         None,
     ),
     (
+        # ISSUE 17: the prefill-tier KV handoff family (OFFER/PAGES/
+        # ACK) is the THIRD frame family — a tier module importing the
+        # shared HELLO/GOODBYE and silently dropping one of its own KV
+        # frames must fire, while the fully-handled worker-side chain
+        # (subset + loud else) stays clean.
+        "frame-exhaustive",
+        {
+            "wire_kv.py": """
+            FRAME_HELLO = 1
+            FRAME_GOODBYE = 5
+            """,
+            "prefill.py": """
+            from wire_kv import FRAME_GOODBYE, FRAME_HELLO
+
+            FRAME_KV_OFFER = 32
+            FRAME_KV_PAGES = 33
+            FRAME_KV_ACK = 34
+
+            def worker_dispatch(kind, payload):
+                if kind == FRAME_KV_OFFER:
+                    return ("prefill", payload)
+                elif kind == FRAME_GOODBYE:
+                    return None
+                # KV_ACK (telemetry) and a stray HELLO silently eaten
+            """,
+        },
+        {
+            "wire_kv.py": """
+            FRAME_HELLO = 1
+            FRAME_GOODBYE = 5
+            """,
+            "prefill.py": """
+            from wire_kv import FRAME_GOODBYE, FRAME_HELLO
+
+            FRAME_KV_OFFER = 32
+            FRAME_KV_PAGES = 33
+            FRAME_KV_ACK = 34
+
+            def worker_dispatch(kind, payload):
+                if kind == FRAME_KV_OFFER:
+                    return ("prefill", payload)
+                elif kind == FRAME_KV_ACK:
+                    return ("ack", payload)
+                elif kind == FRAME_GOODBYE:
+                    return None
+                else:
+                    raise ValueError(f"unexpected frame {kind}")
+            """,
+        },
+        None,
+    ),
+    (
         # header format drifted from the registered PROTOCOL_VERSION
         # entry (the PR 9 v3-to-v4 rule, structurally checked)
         "frame-exhaustive",

@@ -1,0 +1,248 @@
+"""Tiered KV prefix cache tests (ISSUE 17 tentpole, part a).
+
+The device page pool spills LRU-evicted cached pages to a host-RAM
+tier (``HostKVCache``, ``rollout.host_cache_bytes``) and re-admits
+them on a later prefix hit, skipping the prefill forward.  The
+acceptance bar everywhere: the tiered path is bit-exact — tokens AND
+logprobs — against the cold path, under both scheduler impls, under
+``kv.spill`` chaos, and composed with chunked prefill + speculative
+decoding.  Eviction/spill sequences are seeded and must replay
+identically (the tier analogue of the FaultPlan event witness)."""
+
+import jax
+import numpy as np
+import pytest
+
+from orion_tpu.config import ModelConfig, RolloutConfig
+from orion_tpu.models import Transformer, init_params
+from orion_tpu.resilience.inject import FaultPlan, active_plan
+from orion_tpu.rollout.continuous import ContinuousBatchingEngine
+from orion_tpu.rollout.host_cache import HostKVCache
+from orion_tpu.runtime import PyScheduler
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = ModelConfig.tiny(dtype="float32")
+    model = Transformer(cfg)
+    params = init_params(model, jax.random.key(0), cfg)
+    return cfg, model, params
+
+
+def _mk(model, cfg, params, **kw):
+    base = dict(max_prompt_len=32, max_new_tokens=8, temperature=0.0,
+                page_size=4, max_batch_size=4, num_pages=14,
+                page_watermark=0)
+    base.update(kw)
+    eng = ContinuousBatchingEngine(model, cfg, RolloutConfig(**base),
+                                   eos_token_id=None, segment_len=4)
+    eng.load_weights(params)
+    return eng
+
+
+def _churn_scenario(eng, cfg, key):
+    """Warm one long prompt, churn the tiny pool with fillers until
+    its cached pages are LRU-evicted, then resubmit it — the tiered
+    engine must re-admit from host RAM, the cold one re-prefills.
+    Sequential submits: identical wave structure in both engines."""
+    rng = np.random.RandomState(7)
+    p1 = rng.randint(1, cfg.vocab_size, 30).astype(np.int32)
+    fillers = [rng.randint(1, cfg.vocab_size, 28).astype(np.int32)
+               for _ in range(3)]
+    eng.reset_rng(key)
+    out = {}
+
+    def run(rid, ids):
+        eng.submit(rid, ids, budget=4)
+        waves = 0
+        while eng.pending:
+            for r in eng.step():
+                out[r.req_id] = r
+            waves += 1
+            assert waves < 300
+    run(0, p1)
+    for j, f in enumerate(fillers):
+        run(1 + j, f)
+    run(10, p1)
+    return out
+
+
+@pytest.mark.parametrize("impl", ["native", "python"])
+def test_tiered_readmit_bit_exact(setup, impl, monkeypatch):
+    """Spill -> re-admit round trip is bit-exact (tokens AND logprobs)
+    vs the cold path, in BOTH scheduler impls, and the tier actually
+    engaged (spills, host hits and re-admits all > 0)."""
+    cfg, model, params = setup
+    if impl == "python":
+        monkeypatch.setattr("orion_tpu.rollout.continuous.Scheduler",
+                            PyScheduler)
+    cold = _mk(model, cfg, params)
+    warm = _mk(model, cfg, params, host_cache_bytes=1 << 24)
+    base = _churn_scenario(cold, cfg, jax.random.key(1))
+    got = _churn_scenario(warm, cfg, jax.random.key(1))
+    assert sorted(got) == sorted(base)
+    for rid in base:
+        np.testing.assert_array_equal(got[rid].tokens, base[rid].tokens,
+                                      err_msg=f"req {rid}")
+        np.testing.assert_array_equal(got[rid].logprobs,
+                                      base[rid].logprobs,
+                                      err_msg=f"req {rid}")
+    hc = warm._host_cache
+    assert hc.spills > 0 and hc.hits > 0 and hc.readmits > 0
+    stats = warm.server_stats()
+    assert stats["host_cache_readmits"] == float(hc.readmits)
+    assert stats["host_cache_spills"] == float(hc.spills)
+
+
+def test_tiered_bit_exact_under_chunked_and_speculative(setup):
+    """Composition: host tier + chunked prefill + speculative decode,
+    temp 0 — still bit-exact vs the same composition without the
+    tier."""
+    cfg, model, params = setup
+    kw = dict(chunked_prefill_tokens=8, speculative_k=2)
+    cold = _mk(model, cfg, params, **kw)
+    warm = _mk(model, cfg, params, host_cache_bytes=1 << 24, **kw)
+    base = _churn_scenario(cold, cfg, jax.random.key(2))
+    got = _churn_scenario(warm, cfg, jax.random.key(2))
+    for rid in base:
+        np.testing.assert_array_equal(got[rid].tokens, base[rid].tokens,
+                                      err_msg=f"req {rid}")
+        np.testing.assert_array_equal(got[rid].logprobs,
+                                      base[rid].logprobs,
+                                      err_msg=f"req {rid}")
+    assert warm._host_cache.spills > 0
+
+
+def test_spill_chaos_degrades_not_diverges(setup):
+    """An armed ``kv.spill`` plan drops individual spills — the tier
+    gets colder, the OUTPUT stays bit-identical, and the seeded plan's
+    event witness replays exactly."""
+    cfg, model, params = setup
+    cold = _mk(model, cfg, params)
+    base = _churn_scenario(cold, cfg, jax.random.key(3))
+    witnesses = []
+    for _ in range(2):
+        warm = _mk(model, cfg, params, host_cache_bytes=1 << 24)
+        plan = FaultPlan({"kv.spill": {"p": 0.5}}, seed=11)
+        with active_plan(plan):
+            got = _churn_scenario(warm, cfg, jax.random.key(3))
+        assert plan.events, "plan never fired — not a chaos run"
+        witnesses.append(list(plan.events))
+        for rid in base:
+            np.testing.assert_array_equal(got[rid].tokens,
+                                          base[rid].tokens,
+                                          err_msg=f"req {rid}")
+            np.testing.assert_array_equal(got[rid].logprobs,
+                                          base[rid].logprobs,
+                                          err_msg=f"req {rid}")
+    assert witnesses[0] == witnesses[1]  # seeded replay, bit-identical
+
+
+def test_weight_reload_flushes_both_tiers(setup):
+    """``load_weights`` must flush the host tier with the device cache
+    — stale-weights KV under a still-matching chain hash is the one
+    corruption this design can produce, so it must be impossible."""
+    cfg, model, params = setup
+    eng = _mk(model, cfg, params, host_cache_bytes=1 << 24)
+    _churn_scenario(eng, cfg, jax.random.key(4))
+    assert len(eng._host_cache) > 0
+    # load_weights is identity-cached: the SAME tree keeps both tiers
+    # (its KV is still valid); a NEW tree — even with equal values —
+    # is a reload and must flush totally.
+    eng.load_weights(params)
+    assert len(eng._host_cache) > 0
+    eng.load_weights(jax.tree.map(lambda x: x, params))
+    assert len(eng._host_cache) == 0
+    assert eng.sched.cached_total == 0
+    # and the flushed engine still serves correctly
+    cold = _mk(model, cfg, params)
+    base = _churn_scenario(cold, cfg, jax.random.key(5))
+    got = _churn_scenario(eng, cfg, jax.random.key(5))
+    for rid in base:
+        np.testing.assert_array_equal(got[rid].tokens, base[rid].tokens)
+
+
+def test_server_stats_shape_is_stable(setup):
+    """host_cache_* keys exist (zeroed) with the tier OFF — dashboards
+    keep a stable schema across configs."""
+    cfg, model, params = setup
+    eng = _mk(model, cfg, params)   # no host_cache_bytes
+    stats = eng.server_stats()
+    for k in ("host_cache_entries", "host_cache_bytes",
+              "host_cache_hits", "host_cache_misses",
+              "host_cache_spills", "host_cache_evictions",
+              "host_cache_readmits"):
+        assert stats[k] == 0.0
+
+
+def test_host_cache_knob_requires_prefix_cache(setup):
+    """host_cache_bytes without prefix_cache warns and disables — a
+    silent dead knob would read as 'tier on' in configs."""
+    cfg, model, params = setup
+    with pytest.warns(UserWarning, match="host_cache_bytes"):
+        eng = _mk(model, cfg, params, prefix_cache=False,
+                  host_cache_bytes=1 << 20)
+    assert eng._host_cache is None
+
+
+# -- HostKVCache unit behavior -----------------------------------------
+
+def _page(value, floats=4):
+    return [{"k": np.full(floats, value, np.float32)}]  # 4*floats bytes
+
+
+def test_host_cache_lru_and_accounting():
+    hc = HostKVCache(budget_bytes=48)     # room for three 16-byte pages
+    assert hc.put(1, _page(1)) and hc.put(2, _page(2)) \
+        and hc.put(3, _page(3))
+    assert len(hc) == 3 and hc.bytes_used == 48
+    assert hc.get(1) is not None          # refreshes 1: LRU is now 2
+    assert hc.put(4, _page(4))            # over budget: evicts 2
+    assert hc.get(2) is None and hc.get(1) is not None
+    assert (hc.spills, hc.evictions, hc.hits, hc.misses) == (4, 1, 2, 1)
+    # pop: removal without hit/miss accounting (the re-admit path)
+    assert hc.pop(3) is not None and hc.pop(3) is None
+    assert hc.bytes_used == 32 and len(hc) == 2
+    assert (hc.hits, hc.misses) == (2, 1)
+    # oversize entry: rejected, nothing evicted
+    assert not hc.put(9, _page(9, floats=100))
+    assert len(hc) == 2
+    # clear flushes entries, counters survive; reset zeroes counters
+    assert hc.clear() == 2
+    assert len(hc) == 0 and hc.bytes_used == 0 and hc.spills == 4
+    hc.reset_counters()
+    assert (hc.spills, hc.evictions, hc.hits, hc.misses,
+            hc.readmits) == (0, 0, 0, 0, 0)
+    with pytest.raises(ValueError):
+        HostKVCache(0)
+
+
+def test_host_cache_seeded_sequence_replays_identically():
+    """Byte-budget-overflow churn under a seeded op stream is
+    deterministic: two caches driven by the same seed end with
+    identical entry order, bytes and counters."""
+    import random
+
+    def drive(seed):
+        rng = random.Random(seed)
+        hc = HostKVCache(budget_bytes=5 * 16)
+        trace = []
+        for _ in range(400):
+            h = rng.randrange(12)
+            op = rng.random()
+            if op < 0.5:
+                trace.append(("put", h, hc.put(h, _page(h))))
+            elif op < 0.8:
+                got = hc.get(h)
+                trace.append(("get", h, got is None))
+            else:
+                got = hc.pop(h)
+                trace.append(("pop", h, got is None))
+        trace.append(("end", list(hc._entries), hc.bytes_used,
+                      hc.spills, hc.evictions, hc.hits, hc.misses))
+        return trace
+
+    assert drive(42) == drive(42)
+    # and eviction pressure actually happened
+    end = drive(42)[-1]
+    assert end[4] > 0                     # evictions under churn

@@ -208,6 +208,50 @@ def test_prefix_cache_lru_eviction(mk):
     assert s.free_pages == 0
 
 
+def _spill_script(s):
+    """One fixed spill/re-admit scenario; returns every observable so
+    the two impls can be compared wholesale (PR 17)."""
+    out = []
+    s.add(1, 9, 3, prefix_hashes=(7, 8))
+    out.append([x[0] for x in s.admit()])
+    out.append(s.finish(1))              # hashes 7, 8 graduate
+    out.append(s.drain_evictions())      # graduation is not eviction
+    s.add(2, 9, 7, prefix_hashes=(9, 10))
+    out.append([x[0] for x in s.admit()])  # must evict the LRU page
+    out.append(s.drain_evictions())
+    out.append(s.cache_lookup(7))
+    out.append(s.cache_lookup(8))
+    out.append(s.insert_cached(8))       # already cached
+    out.append(s.insert_cached(7))       # re-admit (may evict colder)
+    out.append(s.drain_evictions())
+    out.append(s.finish(2))
+    out.append(s.insert_cached(11))
+    out.append(s.clear_cache())
+    out.append(s.drain_evictions())      # reload flush is SILENT
+    out.append((s.free_pages, s.available_pages, s.cached_total))
+    return out
+
+
+def test_eviction_events_bit_identical_across_impls():
+    """The spill contract (ordered (hash, page) eviction events,
+    out-of-band insert_cached, silent clear_cache) replays
+    bit-identically in both scheduler impls — the host tier above them
+    therefore sees the same spill stream regardless of impl."""
+    if not native_available():
+        pytest.skip("no toolchain")
+    from orion_tpu.runtime.scheduler import _NativeScheduler
+
+    py = _spill_script(PyScheduler(4, 4, 2, watermark=0))
+    nat = _spill_script(_NativeScheduler(4, 4, 2, watermark=0))
+    assert py == nat
+    # and the scenario actually exercised the contract:
+    assert py[2] == []                   # no events from graduation
+    assert len(py[4]) == 1 and py[4][0][0] == 7   # LRU hash spilled
+    assert py[5] == -1 and py[6] >= 0    # 7 gone, 8 resident
+    assert py[7] == -2                   # insert of a resident hash
+    assert py[13] == []                  # clear_cache emits nothing
+
+
 def _drive(a, b, seed, policy, max_k=4, n_ops=700, tenants=False):
     """Randomized step-for-step cross-check of the full PR 8 contract
     (solo + group adds with priorities/deadlines/prefix hashes, admit,
@@ -278,6 +322,17 @@ def _drive(a, b, seed, policy, max_k=4, n_ops=700, tenants=False):
             rid = waiting_ids.pop(rng.randrange(len(waiting_ids)))
             a.cancel(rid)
             b.cancel(rid)
+        elif op < 0.98:
+            # PR 17 host-tier hooks: lookup + out-of-band insert (the
+            # re-admit path) must agree bit-for-bit, including the
+            # page number a successful insert lands on.
+            h = rng.choice(hash_pool)
+            assert a.cache_lookup(h) == b.cache_lookup(h)
+            assert a.insert_cached(h) == b.insert_cached(h)
+        else:
+            # Eviction event streams (hash, page) are the spill
+            # contract: identical ORDER, not just identical sets.
+            assert a.drain_evictions() == b.drain_evictions()
         assert (a.free_pages, a.available_pages, a.cached_total,
                 a.waiting, a.running) == \
                (b.free_pages, b.available_pages, b.cached_total,

@@ -99,6 +99,75 @@ def test_streamed_tokens_bit_exact_vs_generate(setup, temperature):
         np.testing.assert_array_equal(fin[i].logprobs, base[i].logprobs)
 
 
+@pytest.mark.parametrize("temperature", [0.0, 1.0])
+def test_streamed_logprobs_bit_exact(setup, temperature):
+    """``submit(..., logprobs=True)`` (ISSUE 17 satellite): every
+    chunk carries the sampling logprobs for exactly its tokens, the
+    concatenation equals the completed record's ``logprobs`` AND the
+    generate() baseline bit for bit, and the knob is per-request —
+    a plain streamed request keeps ``chunk.logprobs is None``."""
+    cfg, model, params = setup
+    prompts = _prompts(cfg, seed=13, n=4)
+    base = {r.req_id: r for r in
+            _mk(model, cfg, params, temperature=temperature,
+                prefix_cache=False).generate(
+                    [(i, p) for i, p in enumerate(prompts)],
+                    jax.random.key(11), params)}
+    eng = _mk(model, cfg, params, temperature=temperature,
+              prefix_cache=False)
+    eng.reset_rng(jax.random.key(11))
+    lp_chunks = {i: [] for i in range(len(prompts))}
+    cb_lp = {i: [] for i in range(len(prompts))}
+    fin = {}
+    for i, p in enumerate(prompts):
+        if i == 0:
+            eng.submit(i, p, stream=True)          # logprobs OFF
+        elif i == 1:
+            eng.submit(i, p, stream=True, logprobs=True,
+                       on_tokens=lambda ch, q=i:    # callback path
+                       cb_lp[q].append(ch))
+        else:
+            eng.submit(i, p, stream=True, logprobs=True)
+    waves = 0
+    while eng.pending:
+        eng.step()
+        for i in (0, 2, 3):
+            if i in fin:
+                continue
+            try:
+                ch = eng.poll(i)
+            except KeyError:
+                continue
+            if ch is None:
+                continue
+            if i == 0:
+                assert ch.logprobs is None   # per-request knob
+            else:
+                assert ch.logprobs is not None
+                assert len(ch.logprobs) == len(ch.tokens)
+                if ch.restarted:
+                    lp_chunks[i] = []
+                lp_chunks[i].append(ch.logprobs)
+            if ch.done:
+                fin[i] = ch.completed
+        waves += 1
+        assert waves < 300
+    for ch in cb_lp[1]:
+        assert ch.logprobs is not None
+        assert len(ch.logprobs) == len(ch.tokens)
+        if ch.done:
+            fin[1] = ch.completed
+    lp_chunks[1] = [ch.logprobs for ch in cb_lp[1]]
+    for i in (1, 2, 3):
+        got = (np.concatenate(lp_chunks[i]) if lp_chunks[i]
+               else np.empty(0, np.float32))
+        np.testing.assert_array_equal(got, fin[i].logprobs,
+                                      err_msg=f"req {i}")
+        np.testing.assert_array_equal(got, base[i].logprobs,
+                                      err_msg=f"req {i}")
+        np.testing.assert_array_equal(fin[i].tokens, base[i].tokens)
+
+
 def test_streamed_bit_exact_under_cache_and_chunked_prefill(setup):
     """Composition: prefix cache + chunked prefill active, temp 1 —
     the streamed sequence still equals generate() bit for bit

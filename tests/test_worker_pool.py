@@ -348,6 +348,71 @@ def test_heartbeat_silence_marks_dead_and_discards_inflight():
         pool.shutdown()
 
 
+def test_retire_member_scales_down_gracefully():
+    """PR 17 satellite: ``retire_member`` GOODBYEs the NEWEST live
+    member (LIFO — the longest-warmed workers keep serving), the
+    worker exits through its graceful path (leave, not death), and an
+    empty pool returns None."""
+    pool = WorkerPool(0, heartbeat_timeout=5.0)
+    try:
+        pool.broadcast({"w": np.ones(1)}, 0)
+        w0 = FakeWorker(pool.port, 0)
+        _wait_until(lambda: pool.recovery["worker_joins"] == 1,
+                    msg="w0 to join")
+        w1 = FakeWorker(pool.port, 1)
+        _wait_until(lambda: pool.recovery["worker_joins"] == 2,
+                    msg="w1 to join")
+        assert pool.retire_member() == 1          # newest first
+        _wait_until(lambda: pool.recovery["worker_leaves"] == 1,
+                    msg="retired worker to leave")
+        w1.join()
+        assert w1.error is None
+        assert pool.recovery["worker_deaths"] == 0
+        assert ("worker-retire", 1) in pool.events
+        assert [m.wid for m in pool.live_members()] == [0]
+        # explicit wid targeting
+        assert pool.retire_member(wid=99) is None   # no such member
+        assert pool.retire_member(wid=0) == 0
+        _wait_until(lambda: pool.recovery["worker_leaves"] == 2,
+                    msg="w0 to leave")
+        w0.join()
+        assert pool.retire_member() is None         # empty pool
+    finally:
+        pool.shutdown()
+
+
+def test_launch_retire_actuator_sweeps_exited_procs():
+    """The launch.py retire actuator retires through the pool AND
+    sweeps already-exited Popen handles out of the reap list; with
+    nothing to retire it raises (the autopilot records retire_failed
+    instead of counting a no-op scale-down)."""
+    from orion_tpu.launch import _retire_pool_worker
+
+    class _Proc:
+        def __init__(self, exited):
+            self._e = exited
+
+        def poll(self):
+            return 0 if self._e else None
+
+    pool = WorkerPool(0, heartbeat_timeout=5.0)
+    try:
+        pool.broadcast({"w": np.ones(1)}, 0)
+        w0 = FakeWorker(pool.port, 0)
+        _wait_until(lambda: pool.recovery["worker_joins"] == 1,
+                    msg="w0 to join")
+        procs = [_Proc(True), _Proc(False), _Proc(True)]
+        assert _retire_pool_worker(pool, procs) == 0
+        assert len(procs) == 1            # exited handles swept
+        _wait_until(lambda: pool.recovery["worker_leaves"] == 1,
+                    msg="retired worker to leave")
+        w0.join()
+        with pytest.raises(RuntimeError, match="no live"):
+            _retire_pool_worker(pool, procs)
+    finally:
+        pool.shutdown()
+
+
 def test_crash_discards_backlog_but_goodbye_keeps_it():
     pool = WorkerPool(0, heartbeat_timeout=5.0)
     try:
