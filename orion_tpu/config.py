@@ -694,6 +694,61 @@ class ControllerConfig:
 
 
 @dataclass
+class RolloutUpdateConfig:
+    """Zero-downtime fleet weight rollout (orchestration.rollout_controller).
+
+    Governs the blue/green per-engine cycle the
+    ``WeightRolloutCoordinator`` runs when a new version-tagged param
+    snapshot lands: DRAINING (stop admitting; in-flight requests finish
+    or migrate with a RESTARTED stream marker at the drain deadline) →
+    RELOAD (swap params, both KV tiers cleared) → CANARY (pinned greedy
+    probes must return finite logprobs and match the recorded
+    fingerprint shape) → READMIT.  Old params are retained until the
+    fleet-wide commit point so every fault path can roll back."""
+
+    # Pinned greedy probe requests per engine at the canary gate (0
+    # disables the gate — reload goes straight to readmit).
+    canary_prompts: int = 2
+    # Token budget per canary probe (clamped to rollout.max_new_tokens).
+    canary_budget: int = 4
+    # Coordinator ticks (gateway pump iterations) an engine may spend
+    # DRAINING before its in-flight requests are migrated to another
+    # engine with a typed RESTARTED stream marker.  Tick-counted, not
+    # wall-clock, so chaos runs replay bit-identically.
+    drain_deadline_ticks: int = 200
+    # Engines allowed in their blue/green cycle simultaneously.  1 =
+    # strictly one-at-a-time (the default rolling update); must stay
+    # below the fleet size or availability drops to zero.
+    max_concurrent_drains: int = 1
+    # What a failed step does: "auto" rolls every upgraded engine back
+    # to the old snapshot; "halt" gates the failed engine off and stops
+    # the roll (operator decides), leaving healthy engines serving.
+    rollback_policy: str = "auto"
+
+    def __post_init__(self) -> None:
+        if self.canary_prompts < 0:
+            raise ValueError(
+                f"rollout_update.canary_prompts must be >= 0, got "
+                f"{self.canary_prompts}")
+        if self.canary_budget < 1:
+            raise ValueError(
+                f"rollout_update.canary_budget must be >= 1, got "
+                f"{self.canary_budget}")
+        if self.drain_deadline_ticks < 1:
+            raise ValueError(
+                f"rollout_update.drain_deadline_ticks must be >= 1, got "
+                f"{self.drain_deadline_ticks}")
+        if self.max_concurrent_drains < 1:
+            raise ValueError(
+                f"rollout_update.max_concurrent_drains must be >= 1, "
+                f"got {self.max_concurrent_drains}")
+        if self.rollback_policy not in ("auto", "halt"):
+            raise ValueError(
+                f"rollout_update.rollback_policy must be 'auto' or "
+                f"'halt', got {self.rollback_policy!r}")
+
+
+@dataclass
 class TrainConfig:
     """Common trainer settings shared by all algorithms."""
 
@@ -770,6 +825,11 @@ class TrainConfig:
     # typed setpoints + the load-shed rung of the degradation ladder.
     controller: ControllerConfig = field(
         default_factory=ControllerConfig)
+    # Zero-downtime fleet weight rollout
+    # (orion_tpu.orchestration.rollout_controller): blue/green drain →
+    # reload → canary → readmit per engine, with auto-rollback.
+    rollout_update: RolloutUpdateConfig = field(
+        default_factory=RolloutUpdateConfig)
 
 
 @dataclass

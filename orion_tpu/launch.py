@@ -16,7 +16,9 @@ on the in-process rollout thread.
 
 Serving gateway (PR 12, ROADMAP item 1 shipped-core):
 ``python -m orion_tpu.launch serve [--port N] [--tenants SPEC]
-[key=value ...]`` builds the continuous engine from the same config
+[--engines N] [--rollout] [key=value ...]`` builds the continuous
+engine (a fleet of them with ``--engines``; ``--rollout`` arms the
+PR 18 blue/green weight-rollout coordinator) from the same config
 surface (``rollout.*``, ``hf_path``/``model_preset``) through the same
 engine construction the pool workers use, and fronts it with a
 :class:`~orion_tpu.orchestration.gateway.ServingGateway` — remote
@@ -271,13 +273,21 @@ def run_pool_worker(cfg, port: int, rank: int,
 
 def run_serve(cfg, port: int = 0, tenant_spec: Optional[str] = None,
               host: str = "localhost", stop=None,
-              on_ready=None) -> Any:
+              on_ready=None, n_engines: int = 1,
+              rollout: bool = False) -> Any:
     """Serving-gateway process body (PR 12): the continuous engine as
     a network service.  Builds the engine through the same machinery
     the pool workers use (:func:`build_rollout_engine`), loads weights
     (HF checkpoint via ``hf_path`` or a seeded random init), fronts it
     with a :class:`ServingGateway`, and pumps until ``stop`` fires or
     SIGTERM/SIGINT arrives (graceful drain, exit 0).
+
+    ``--engines N`` (PR 18) builds a fleet of N identical engines
+    behind ONE gateway (deterministic least-pending routing);
+    ``--rollout`` attaches a
+    :class:`~orion_tpu.orchestration.rollout_controller.WeightRolloutCoordinator`
+    so a version-tagged param push rolls through the fleet blue/green
+    with zero observed downtime (``cfg.rollout_update`` knobs).
 
     ``on_ready(gateway)`` is the in-process harness hook (the tier-1
     smoke learns the ephemeral port from it); ``stop`` is any object
@@ -297,15 +307,20 @@ def run_serve(cfg, port: int = 0, tenant_spec: Optional[str] = None,
         # engine's submit/step surface; serving never uses the
         # fixed-batch engine.
         cfg.rollout.engine = "continuous"
-    engine, _eos, _pad = build_rollout_engine(cfg, tokenizer)
+    engines = []
+    for rank in range(max(1, int(n_engines))):
+        eng, _eos, _pad = build_rollout_engine(cfg, tokenizer)
+        engines.append(eng)
     if cfg.hf_path:
         params = load_hf_pretrained(cfg.hf_path, cfg.model)
         params = jax.device_put(params)
     else:
         params = init_params(Transformer(cfg.model),
                              jax.random.key(cfg.seed), cfg.model)
-    engine.load_weights(params)
-    engine.reset_rng(jax.random.key(cfg.seed + 1))
+    for rank, eng in enumerate(engines):
+        eng.load_weights(params)
+        eng.reset_rng(jax.random.key(cfg.seed + 1 + rank))
+    engine = engines[0]
     tenants = parse_tenant_spec(tenant_spec) if tenant_spec else None
     autopilot = None
     if cfg.controller.enabled:
@@ -315,13 +330,23 @@ def run_serve(cfg, port: int = 0, tenant_spec: Optional[str] = None,
         from orion_tpu.orchestration.autopilot import SLOAutopilot
 
         autopilot = SLOAutopilot(cfg.controller, engine=engine)
-    gw = ServingGateway(engine, port=port, host=host, tenants=tenants,
+    gw = ServingGateway(engines, port=port, host=host, tenants=tenants,
                         autopilot=autopilot)
+    if rollout:
+        # Fleet weight-rollout coordinator (PR 18): ticked from the
+        # gateway pump; a learner thread stages pushes via
+        # ``gw.rollout.begin(params, version)``.
+        from orion_tpu.orchestration.rollout_controller import (
+            WeightRolloutCoordinator)
+
+        WeightRolloutCoordinator(gateway=gw, cfg=cfg.rollout_update,
+                                 autopilot=autopilot)
     handler = None
     if threading.current_thread() is threading.main_thread():
         handler = install_handler()
     print(f"[serve] gateway listening on {host}:{gw.port} "
-          f"(engine slots={engine.slots}, pages={engine.num_pages})",
+          f"(engines={len(engines)}, slots={engine.slots}, "
+          f"pages={engine.num_pages}, rollout={'on' if rollout else 'off'})",
           flush=True)
     if on_ready is not None:
         on_ready(gw)
@@ -455,7 +480,7 @@ def main(argv: Optional[list] = None) -> Any:
         i = argv.index("--config")
         yaml_path = argv[i + 1]
         del argv[i:i + 2]
-    serve_port, tenant_spec = 0, None
+    serve_port, tenant_spec, n_engines, rollout = 0, None, 1, False
     if algo == "serve":
         if "--port" in argv:
             i = argv.index("--port")
@@ -465,6 +490,13 @@ def main(argv: Optional[list] = None) -> Any:
             i = argv.index("--tenants")
             tenant_spec = argv[i + 1]
             del argv[i:i + 2]
+        if "--engines" in argv:
+            i = argv.index("--engines")
+            n_engines = int(argv[i + 1])
+            del argv[i:i + 2]
+        if "--rollout" in argv:
+            argv.remove("--rollout")
+            rollout = True
     cfg_cls, _ = ALGOS.get(algo, (GRPOConfig, None))
     cfg = load_config(cfg_cls, yaml_path=yaml_path, cli_args=argv)
     if cfg.model_preset:
@@ -473,7 +505,8 @@ def main(argv: Optional[list] = None) -> Any:
     if algo == "serve":
         return run_serve(cfg, port=serve_port, tenant_spec=tenant_spec,
                          host=os.environ.get("ORION_SERVE_HOST",
-                                             "localhost"))
+                                             "localhost"),
+                         n_engines=n_engines, rollout=rollout)
 
     # Rollout-worker process (spawned by the pool branch below): the
     # env routing keeps the CLI surface unchanged — a worker re-parses

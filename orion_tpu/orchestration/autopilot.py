@@ -165,6 +165,12 @@ class SLOAutopilot:
         self._cool = 0          # consecutive ticks under every floor
         self._last_transition = -10**9
         self._last_capacity_act = -10**9
+        #: Attached WeightRolloutCoordinator (PR 18).  While a fleet
+        #: roll is in flight the capacity loop is paused: spawning or
+        #: retiring pool workers mid-drain would fight the blue/green
+        #: ladder over who owns the fleet's shape.
+        self.rollout = None
+        self._rollout_paused = False
         # Spec micro-controller streaks + baseline.
         self._spec_low = 0
         self._spec_high = 0
@@ -466,6 +472,17 @@ class SLOAutopilot:
         without the gate a dead pool would fork-bomb."""
         c = self.cfg
         sp = c.workers
+        if self.rollout is not None and self.rollout.active:
+            if not self._rollout_paused:
+                self._rollout_paused = True
+                self.decisions.append(
+                    (self.ticks, "capacity_paused", "rollout"))
+                obs.instant("autopilot.capacity_paused", tick=self.ticks)
+            return
+        if self._rollout_paused:
+            self._rollout_paused = False
+            self.decisions.append(
+                (self.ticks, "capacity_resumed", "rollout"))
         if sp.target <= 0 or "workers" not in sig:
             return
         if self.ticks - self._last_capacity_act <= c.cooldown_ticks:

@@ -71,6 +71,13 @@ _FRAME_NAMES = {
 }
 
 
+class GatewayClosed(ConnectionError):
+    """The gateway said GOODBYE (drain/preemption) or the channel
+    died.  A ConnectionError subclass so existing handlers keep
+    working; the distinct type lets a client tell a deliberate server
+    drain from its own misuse of a closed handle."""
+
+
 @dataclasses.dataclass
 class StreamEvent:
     """Client-side view of one STREAM frame.
@@ -153,7 +160,20 @@ class ServingGateway:
                  recv_deadline: float = 0.0, tracer=None,
                  idle_wait: float = 0.002, autopilot=None,
                  prefill_tier=None):
-        self.engine = engine
+        # Fleet front door (PR 18): ``engine`` may be one engine or a
+        # sequence.  Requests route to the least-loaded ADMITTING
+        # engine; the rollout coordinator gates engines out via
+        # set_engine_admit while it drains/reloads them, and the
+        # gateway routes around them so observed availability never
+        # drops.  ``self.engine`` stays the primary (autopilot signals,
+        # prefill tier, single-engine callers unchanged).
+        self.engines = (list(engine) if isinstance(engine, (list, tuple))
+                        else [engine])
+        self.engine = self.engines[0]
+        self._admit_ok = [True] * len(self.engines)
+        #: WeightRolloutCoordinator attaches itself here; the pump
+        #: drives its ticks (single engine-owner thread).
+        self.rollout = None
         self.host = host
         self._tracer = tracer
         self._idle_wait = idle_wait
@@ -174,13 +194,17 @@ class ServingGateway:
         self.autopilot = autopilot
         self.recv_deadline = recv_deadline
         for name, kw in (tenants or {}).items():
-            engine.configure_tenant(name, **kw)
+            for eng in self.engines:
+                eng.configure_tenant(name, **kw)
         self.watchdog = Watchdog()
         self._lock = threading.Lock()
         self._clients: Dict[int, _Client] = {}
         self._next_cid = 0
         self._next_rid = 0
-        self._live: Dict[int, tuple] = {}   # engine rid -> (client, cid req)
+        # engine rid -> {"client", "creq", "eng" (engine index),
+        # "p" (the submit payload, retained so a drain-deadline
+        # migration can resubmit on another engine)}
+        self._live: Dict[int, dict] = {}
         self._ops: queue.Queue = queue.Queue()
         self._stop = threading.Event()
         self._pump_thread: Optional[threading.Thread] = None
@@ -329,6 +353,62 @@ class ServingGateway:
                 self._live.pop(client.reqs.pop(creq, None), None)
         self._send_stream(client, payload)
 
+    # -- fleet routing (PR 18) -------------------------------------------
+    def set_engine_admit(self, idx: int, ok: bool) -> None:
+        """Admission gate for one engine of the fleet: a gated engine
+        receives no NEW submits (in-flight decoding continues).  The
+        rollout coordinator's DRAINING/READMIT actuator."""
+        with self._lock:
+            self._admit_ok[idx] = bool(ok)
+
+    def engine_admitting(self, idx: int) -> bool:
+        with self._lock:
+            return self._admit_ok[idx]
+
+    def _route_order(self, exclude: Optional[int] = None) -> list:
+        """Admitting engine indices, least-pending first (ties by
+        index — deterministic under seeded replay)."""
+        with self._lock:
+            ok = list(self._admit_ok)
+        return sorted(
+            (i for i in range(len(self.engines))
+             if ok[i] and i != exclude),
+            key=lambda i: (self.engines[i].pending, i))
+
+    def _submit_routed(self, client: _Client, creq: int, rid: int,
+                       p: dict, exclude: Optional[int] = None) -> None:
+        """Submit ``p`` on the first admitting engine that accepts it
+        (least-pending first).  A shed from EVERY admitting engine —
+        or an empty route (whole fleet gated) — propagates as the
+        typed EngineOverloaded; a ValueError (malformed request) is
+        the client's own and is never retried on a sibling."""
+        order = self._route_order(exclude=exclude)
+        if not order:
+            raise EngineOverloaded(
+                "no engine admitting (fleet draining)",
+                queue_depth=sum(e.pending for e in self.engines),
+                retry_after=0.25, tenant=client.tenant)
+        last: Optional[EngineOverloaded] = None
+        for idx in order:
+            try:
+                self.engines[idx].submit(
+                    rid, np.asarray(p["ids"], np.int32),
+                    budget=p.get("budget"),
+                    priority=int(p.get("priority", 0)),
+                    deadline=p.get("deadline"),
+                    tenant=client.tenant, stream=True,
+                    on_tokens=lambda chunk, c=client, q=creq:
+                        self._on_chunk(c, q, chunk))
+            except EngineOverloaded as e:
+                last = e
+                continue
+            with self._lock:
+                client.reqs[creq] = rid
+                self._live[rid] = {"client": client, "creq": creq,
+                                   "eng": idx, "p": p}
+            return
+        raise last
+
     def _apply_submit(self, client: _Client, p: dict) -> None:
         creq = int(p["req"])
         with self._lock:
@@ -342,14 +422,18 @@ class ServingGateway:
         with self._lock:
             rid = self._next_rid
             self._next_rid += 1
-        if self.prefill_tier is not None:
-            # Tier route: the request is live from the client's view
-            # the moment it parks tier-side; engine admission (and any
-            # shed) happens at the pump that sees its KV arrive, and
-            # comes back through _on_tier_shed.
+        if self.prefill_tier is not None and self.engine_admitting(0):
+            # Tier route (primary engine only — the tier's KV lands in
+            # engine 0's cache): the request is live from the client's
+            # view the moment it parks tier-side; engine admission
+            # (and any shed) happens at the pump that sees its KV
+            # arrive, and comes back through _on_tier_shed.  While
+            # engine 0 drains for a weight roll, submits skip the tier
+            # and route directly to a sibling.
             with self._lock:
                 client.reqs[creq] = rid
-                self._live[rid] = (client, creq)
+                self._live[rid] = {"client": client, "creq": creq,
+                                   "eng": 0, "p": p}
                 self.stats["submits"] += 1
             self.prefill_tier.submit(
                 rid, np.asarray(p["ids"], np.int32),
@@ -361,17 +445,8 @@ class ServingGateway:
                     self._on_chunk(c, q, chunk))
             return
         try:
-            self.engine.submit(
-                rid, np.asarray(p["ids"], np.int32),
-                budget=p.get("budget"),
-                priority=int(p.get("priority", 0)),
-                deadline=p.get("deadline"),
-                tenant=client.tenant, stream=True,
-                on_tokens=lambda chunk, c=client, q=creq:
-                    self._on_chunk(c, q, chunk))
+            self._submit_routed(client, creq, rid, p)
             with self._lock:
-                client.reqs[creq] = rid
-                self._live[rid] = (client, creq)
                 self.stats["submits"] += 1
         except EngineOverloaded as e:
             # Typed backpressure crosses the wire: depth + retry hint
@@ -399,7 +474,7 @@ class ServingGateway:
             entry = self._live.pop(rid, None)
         if entry is None:
             return  # client already gone
-        client, creq = entry
+        client, creq = entry["client"], entry["creq"]
         with self._lock:
             client.reqs.pop(creq, None)
         if isinstance(exc, EngineOverloaded):
@@ -420,6 +495,9 @@ class ServingGateway:
         creq = int(p["req"])
         with self._lock:
             rid = client.reqs.get(creq)
+            entry = self._live.get(rid) if rid is not None else None
+            eng = self.engines[entry["eng"]] if entry is not None \
+                else self.engine
         if rid is None:
             return  # finished (or never existed): cancel is a no-op
         if self.prefill_tier is not None:
@@ -427,7 +505,7 @@ class ServingGateway:
             # engine-side cancel below is then the no-op.
             self.prefill_tier.cancel(rid)
         try:
-            self.engine.cancel(rid)
+            eng.cancel(rid)
         except KeyError:
             pass
         with self._lock:
@@ -445,16 +523,18 @@ class ServingGateway:
             client.alive = False
             rids = list(client.reqs.values())
             client.reqs.clear()
+            reap = []
             for rid in rids:
-                self._live.pop(rid, None)
+                entry = self._live.pop(rid, None)
+                reap.append((rid, entry["eng"] if entry else 0))
             self.stats["clients_left"] += 1
         self.watchdog.unregister(client.hb.name)
-        if rids:
+        if reap:
             # Deferred to the next pump iteration: this method can run
             # inside engine.step() (a send failing from a token
             # callback), where an inline engine.cancel would mutate
             # engine state mid-wave.
-            self._ops.put(("reap", None, rids))
+            self._ops.put(("reap", None, reap))
         if goodbye:
             try:
                 client.chan.send_frame(FRAME_GOODBYE,
@@ -468,11 +548,64 @@ class ServingGateway:
         if obs.get_tracer().enabled:
             obs.instant("gw.client-leave", cid=client.cid)
 
+    def migrate_engine_requests(self, idx: int) -> int:
+        """Drain-deadline actuator (pump-owner context only): move
+        every in-flight request off engine ``idx`` — cancel it there,
+        stream a typed RESTARTED marker (the client voids everything
+        delivered so far, exactly like a preemption restart), and
+        resubmit the retained payload on a sibling engine.  The client
+        request never drops: it either readmits elsewhere or gets the
+        normal typed overloaded/bad-request error.  Returns how many
+        requests moved."""
+        with self._lock:
+            victims = [(rid, dict(e)) for rid, e in self._live.items()
+                       if e["eng"] == idx]
+        moved = 0
+        for rid, entry in sorted(victims):
+            client, creq, p = entry["client"], entry["creq"], entry["p"]
+            if self.prefill_tier is not None:
+                self.prefill_tier.cancel(rid)
+            try:
+                self.engines[idx].cancel(rid)
+            except (KeyError, ValueError):
+                pass
+            with self._lock:
+                self._live.pop(rid, None)
+                client.reqs.pop(creq, None)
+            # The restart marker precedes the new engine's chunks, so
+            # the client discards the old engine's partial delivery.
+            self._send_stream(client, {
+                "req": creq, "tokens": np.empty(0, np.int32),
+                "done": False, "restarted": True})
+            with self._lock:
+                new_rid = self._next_rid
+                self._next_rid += 1
+            try:
+                self._submit_routed(client, creq, new_rid, p,
+                                    exclude=idx)
+                moved += 1
+            except EngineOverloaded as e:
+                with self._lock:
+                    self.stats["sheds"] += 1
+                self._send_stream(client, {
+                    "req": creq, "done": True,
+                    "tokens": np.empty(0, np.int32),
+                    "error": "overloaded", "message": str(e),
+                    "queue_depth": e.queue_depth,
+                    "retry_after": e.retry_after, "tenant": e.tenant})
+            except ValueError as e:
+                self._send_stream(client, {
+                    "req": creq, "done": True,
+                    "tokens": np.empty(0, np.int32),
+                    "error": "bad-request", "message": str(e)})
+        return moved
+
     def step(self) -> int:
-        """One pump iteration: apply queued client ops, run one engine
-        wave, fan out the resulting stream chunks (the engine fires
-        the callbacks inside ``step()``).  Returns the number of
-        requests still in flight."""
+        """One pump iteration: apply queued client ops, tick the
+        rollout coordinator (if attached), run one wave on every
+        engine with work, fan out the resulting stream chunks (each
+        engine fires the callbacks inside ``step()``).  Returns the
+        number of requests still in flight fleet-wide."""
         while True:
             try:
                 op, client, payload = self._ops.get_nowait()
@@ -487,9 +620,9 @@ class ServingGateway:
             elif op == "reap":
                 # Engine-side aborts for a client dropped mid-wave —
                 # applied here, OUTSIDE any engine.step().
-                for rid in payload:
+                for rid, eng in payload:
                     try:
-                        self.engine.cancel(rid)
+                        self.engines[eng].cancel(rid)
                     except (KeyError, ValueError):
                         pass
             else:  # pragma: no cover - internal op enum
@@ -502,8 +635,17 @@ class ServingGateway:
             with self._lock:
                 self.stats.update({"prefill_" + k: v for k, v in
                                    self.prefill_tier.stats.items()})
-        if self.engine.pending:
-            self.engine.step()
+        if self.rollout is not None:
+            # Blue/green weight rollout (PR 18): the coordinator's
+            # whole state machine runs on this thread — the single
+            # engine owner — so drain checks, param swaps and canary
+            # probes never race a wave.
+            if self.rollout.tick():
+                with self._lock:
+                    self.stats.update(self.rollout.counters())
+        for eng in self.engines:
+            if eng.pending:
+                eng.step()
         if self.autopilot is not None:
             # Wall-clock-gated inside: at most one decision per
             # cfg.controller.tick_interval regardless of pump rate.
@@ -512,7 +654,7 @@ class ServingGateway:
             if self.autopilot.ticks != before:
                 with self._lock:
                     self.stats.update(self.autopilot.counters())
-        return int(self.engine.pending)
+        return int(sum(e.pending for e in self.engines))
 
     def serve_forever(self, stop: Optional[threading.Event] = None,
                       preemption=None, hb=None) -> None:
@@ -573,9 +715,9 @@ class ServingGateway:
             except queue.Empty:
                 break
             if op == "reap":
-                for rid in payload:
+                for rid, eng in payload:
                     try:
-                        self.engine.cancel(rid)
+                        self.engines[eng].cancel(rid)
                     except (KeyError, ValueError):
                         pass
         if self._accept_thread.is_alive():
@@ -628,7 +770,14 @@ class GatewayClient:
             name="gw-client-recv", daemon=True)
         self._rx_thread.start()
 
+    #: Queue sentinel: the recv loop died (GOODBYE or channel error).
+    #: Wakes any blocked ``next_event`` so a server drain surfaces as
+    #: a typed :class:`GatewayClosed` instead of hanging forever (or
+    #: until ``channel_recv_deadline``) in ``Queue.get``.
+    _CLOSED = object()
+
     def _recv_loop(self, hb) -> None:
+        reason = "connection lost"
         try:
             while not self.closed.is_set():
                 hb.beat()
@@ -636,15 +785,19 @@ class GatewayClient:
                 if kind == FRAME_STREAM:
                     self._events.put(self._to_event(p))
                 elif kind == FRAME_GOODBYE:
+                    reason = str(p.get("reason", "goodbye"))
                     self.closed.set()
-                    return
+                    break
                 else:
                     raise ProtocolError(
                         f"unexpected {_FRAME_NAMES.get(kind, kind)} "
                         "frame from gateway")
         except (ConnectionError, TimeoutError, OSError, EOFError,
-                pickle.UnpicklingError):
+                pickle.UnpicklingError) as e:
+            reason = repr(e)
             self.closed.set()
+        self._close_reason = reason
+        self._events.put(self._CLOSED)
 
     @staticmethod
     def _to_event(p: dict) -> StreamEvent:
@@ -749,15 +902,27 @@ class GatewayClient:
     def next_event(self, timeout: Optional[float] = None
                    ) -> Optional[StreamEvent]:
         """The next StreamEvent from any in-flight request, or None on
-        timeout.  Raises ConnectionError once the channel is closed
-        AND the buffered events are drained."""
+        timeout.  Raises :class:`GatewayClosed` (a ConnectionError)
+        once the channel is closed AND the buffered events are drained
+        — including from a ``timeout=None`` block: the recv loop's
+        closing sentinel wakes the wait, so a gateway drain (server
+        preemption GOODBYE) surfaces immediately as the typed error
+        instead of hanging."""
         try:
-            return self._events.get(timeout=timeout)
+            ev = self._events.get(timeout=timeout)
         except queue.Empty:
             if self.closed.is_set():
-                raise ConnectionError(
+                raise GatewayClosed(
                     "gateway connection closed") from None
             return None
+        if ev is self._CLOSED:
+            # Keep the sentinel visible to any other waiter, then
+            # surface the typed close.
+            self._events.put(self._CLOSED)
+            raise GatewayClosed(
+                "gateway connection closed: "
+                f"{getattr(self, '_close_reason', 'unknown')}")
+        return ev
 
     def close(self) -> None:
         if not self.closed.is_set():
@@ -766,6 +931,9 @@ class GatewayClient:
             except (ConnectionError, TimeoutError, OSError):
                 pass
         self.closed.set()
+        self._close_reason = getattr(self, "_close_reason",
+                                     "closed by client")
+        self._events.put(self._CLOSED)
         try:
             self.chan.close()
         except OSError:

@@ -252,7 +252,7 @@ class PrefillTierCoordinator:
         self._arrived: queue.Queue = queue.Queue()
         self._lock = threading.Lock()
         self.stats = {"offers": 0, "handoffs": 0, "pages_injected": 0,
-                      "fallbacks": 0, "sheds": 0}
+                      "fallbacks": 0, "sheds": 0, "stale_offers": 0}
         self.chan = PyTreeChannel.connect(
             port, host=host, timeout=connect_timeout,
             recv_deadline=recv_deadline, tracer=tracer)
@@ -308,7 +308,12 @@ class PrefillTierCoordinator:
                             deadline=deadline, tenant=tenant,
                             stream=stream, on_tokens=on_tokens,
                             logprobs=logprobs),
-                 "deadline": deadline}
+                 "deadline": deadline,
+                 # Weight-version stamp (PR 18 bugfix): the offer's KV
+                 # is computed under the CURRENT decode weights; if the
+                 # engine reloads before the pages come back, injecting
+                 # them would serve stale-weight KV as a prefix hit.
+                 "wv": getattr(self.engine, "weight_version", 0)}
         rid = int(req_id)
         with self._lock:
             self._pending[rid] = entry
@@ -368,15 +373,28 @@ class PrefillTierCoordinator:
         if entry is None:
             return 0  # cancelled while in flight, or duplicate PAGES
         injected = 0
-        try:
-            # Chaos boundary: the whole injection is one fault point —
-            # a kv.handoff fault skips it and the request cold-admits,
-            # bit-identically.
-            fault_point("kv.handoff")
-            injected = self._inject(payload.get("pages") or [])
-        except InjectedFault:
+        if entry.get("wv", 0) != getattr(self.engine,
+                                         "weight_version", 0):
+            # Stale offer (PR 18 bugfix): the engine reloaded weights
+            # after this KV was offered — its pages were computed
+            # under the OLD snapshot and must never enter the cache.
+            # The request itself survives: cold local prefill below.
+            self.stats["stale_offers"] += 1
             self.stats["fallbacks"] += 1
-            obs.instant("kv.handoff_dropped", req=rid)
+            obs.instant("kv.offer_stale", req=rid,
+                        offered=entry.get("wv", 0),
+                        current=getattr(self.engine,
+                                        "weight_version", 0))
+        else:
+            try:
+                # Chaos boundary: the whole injection is one fault
+                # point — a kv.handoff fault skips it and the request
+                # cold-admits, bit-identically.
+                fault_point("kv.handoff")
+                injected = self._inject(payload.get("pages") or [])
+            except InjectedFault:
+                self.stats["fallbacks"] += 1
+                obs.instant("kv.handoff_dropped", req=rid)
         if not self._closed.is_set():
             try:
                 self.chan.send_frame(FRAME_KV_ACK,

@@ -102,7 +102,12 @@ MAGIC = b"ORTP"
 #: header change, but a v5 peer must be turned away at HELLO, not
 #: when a KV_PAGES frame (megabytes of paged KV) lands on a peer
 #: that cannot dispatch it.
-PROTOCOL_VERSION = 6
+#: v7: FRAME_WEIGHTS_ACK joined the pool family and WEIGHTS grew the
+#: two-phase staged/commit/abort push (zero-downtime fleet rollout) —
+#: header unchanged, but a v6 worker neither ACKs weights nor
+#: understands a staged snapshot, so a skewed peer must be rejected
+#: at HELLO, not discovered when the commit point times out.
+PROTOCOL_VERSION = 7
 
 #: magic(4) + version(u16) + kind(u8) + trace id(u64) + originating
 #: span id(u64) + payload length(u64).  The trace/span ids are 0 when
@@ -122,22 +127,25 @@ _HEADER_HISTORY = {
     4: ">4sHBQQQ",   # PR 9: + trace id + span id (distributed tracing)
     5: ">4sHBQQQ",   # PR 12: same header; gateway frame family added
     6: ">4sHBQQQ",   # PR 17: same header; prefill-tier KV family added
+    7: ">4sHBQQQ",   # PR 18: same header; WEIGHTS_ACK/commit handshake
 }
 
 # Frame kinds multiplexed on one channel.
-FRAME_DATA = 0       # legacy send()/recv() payload
-FRAME_HELLO = 1      # worker → learner admission; learner → worker ack
-FRAME_HEARTBEAT = 2  # worker → learner liveness
-FRAME_TRAJ = 3       # worker → learner trajectory batch
-FRAME_WEIGHTS = 4    # learner → worker version-tagged param snapshot
-FRAME_GOODBYE = 5    # either side: graceful leave (≠ crash)
-FRAME_ACK = 6        # learner → worker: consumed-count (backpressure)
+FRAME_DATA = 0        # legacy send()/recv() payload
+FRAME_HELLO = 1       # worker → learner admission; learner → worker ack
+FRAME_HEARTBEAT = 2   # worker → learner liveness
+FRAME_TRAJ = 3        # worker → learner trajectory batch
+FRAME_WEIGHTS = 4     # learner → worker: version-tagged param snapshot
+                      # (plain install, or staged/commit/abort — v7)
+FRAME_GOODBYE = 5     # either side: graceful leave (≠ crash)
+FRAME_ACK = 6         # learner → worker: consumed-count (backpressure)
+FRAME_WEIGHTS_ACK = 7  # worker → learner: weight version staged/applied
 
 _FRAME_NAMES = {
     FRAME_DATA: "DATA", FRAME_HELLO: "HELLO",
     FRAME_HEARTBEAT: "HEARTBEAT", FRAME_TRAJ: "TRAJ",
     FRAME_WEIGHTS: "WEIGHTS", FRAME_GOODBYE: "GOODBYE",
-    FRAME_ACK: "ACK",
+    FRAME_ACK: "ACK", FRAME_WEIGHTS_ACK: "WEIGHTS_ACK",
 }
 
 
@@ -427,6 +435,8 @@ class PoolMember:
         self.hb = hb                      # resilience.Heartbeat
         self.queue: queue.Queue = queue.Queue()
         self.version = -1                 # last WEIGHTS version sent
+        self.staged_version = -1          # WEIGHTS_ACK'd as staged
+        self.acked_version = -1           # WEIGHTS_ACK'd as applied
         self.alive = True
         self.left = False                 # GOODBYE received (graceful)
         self.produced = 0                 # TRAJ frames received
@@ -736,6 +746,20 @@ class WorkerPool:
                             member.queue.put(payload)
                         else:
                             self.recovery["discarded_batches"] += 1
+                elif kind == FRAME_WEIGHTS_ACK:
+                    # v7 push handshake: the worker confirms a weight
+                    # version landed — ``staged`` (held inactive until
+                    # commit) or applied.  The commit point in
+                    # :meth:`push_weights` gates on these.
+                    member.hb.beat()
+                    with self._lock:
+                        v = int(payload["version"])
+                        if payload.get("staged"):
+                            member.staged_version = max(
+                                member.staged_version, v)
+                        else:
+                            member.acked_version = max(
+                                member.acked_version, v)
                 elif kind == FRAME_GOODBYE:
                     self._mark_left(member)
                     return
@@ -895,6 +919,97 @@ class WorkerPool:
                 self._mark_dead(m, f"version broadcast failed: {e!r}")
         return sent
 
+    def _send_weights_ctl(self, key: str, version: int) -> int:
+        """Fan a tiny WEIGHTS control frame (``{key: version}`` —
+        ``commit`` or ``abort``) out to every live member; a failed
+        send marks that worker dead, same as :meth:`broadcast`."""
+        with self._lock:
+            members = [self._members[w] for w in self._order
+                       if self._members[w].alive]
+        sent = 0
+        for m in members:
+            try:
+                m.chan.send_frame(FRAME_WEIGHTS, {key: int(version)})
+                if key == "commit":
+                    m.version = int(version)
+                sent += 1
+            except (ConnectionError, TimeoutError, OSError) as e:
+                self._mark_dead(m, f"weights {key} send failed: {e!r}")
+        return sent
+
+    def broadcast_staged(self, params_host: Any, version: int) -> int:
+        """Phase one of the v7 two-phase push: ship the snapshot with
+        ``staged=True`` — workers hold it INACTIVE (generation keeps
+        running on the old params) and WEIGHTS_ACK it as staged.  The
+        snapshot only becomes live when :meth:`_send_weights_ctl`
+        ships the commit; a learner that dies in between leaves every
+        worker on the old version (a torn push self-heals)."""
+        with self._lock:
+            members = [self._members[w] for w in self._order
+                       if self._members[w].alive]
+        blob = pickle.dumps({"version": version, "params": params_host,
+                             "staged": True},
+                            protocol=pickle.HIGHEST_PROTOCOL)
+        sent = 0
+        for m in members:
+            try:
+                m.chan.send_raw(FRAME_WEIGHTS, blob)
+                sent += 1
+            except (ConnectionError, TimeoutError, OSError) as e:
+                self._mark_dead(m, f"staged broadcast failed: {e!r}")
+        return sent
+
+    def wait_weights_ack(self, version: int, timeout: float = 30.0,
+                         staged: bool = False) -> bool:
+        """Block until every LIVE member has WEIGHTS_ACK'd ``version``
+        (as staged when ``staged=True``, else as applied).  Members
+        that die while we wait stop being waited on — the commit point
+        gates on the survivors, and the push layer decides whether a
+        shrunken fleet is acceptable.  Returns False on timeout."""
+        deadline = time.monotonic() + timeout
+        attr = "staged_version" if staged else "acked_version"
+        while True:
+            with self._lock:
+                lagging = [m.wid for m in self._members.values()
+                           if m.alive and getattr(m, attr) < version]
+            if not lagging:
+                return True
+            if time.monotonic() >= deadline:
+                _LOG.warning(
+                    "weights v%d %s-ack timed out; lagging wids=%s",
+                    version, "staged" if staged else "applied", lagging)
+                return False
+            time.sleep(0.01)
+
+    def push_weights(self, params_host: Any, version: int,
+                     timeout: float = 30.0) -> bool:
+        """The production model-push path (v7): stage the snapshot on
+        every live worker, wait for all staged ACKs, then commit —
+        workers swap atomically and ACK the applied version.  Any
+        failure before the commit point aborts the push: workers drop
+        the staged snapshot and keep generating on the OLD version
+        (``weights.push`` is the chaos boundary).  Returns True only
+        when every live member applied the new version."""
+        fault_point("weights.push")
+        obs.instant("pool.push-weights", version=version)
+        try:
+            if self.broadcast_staged(params_host, version) == 0:
+                return False
+            if not self.wait_weights_ack(version, timeout=timeout,
+                                         staged=True):
+                self._send_weights_ctl("abort", version)
+                return False
+        except Exception:
+            self._send_weights_ctl("abort", version)
+            raise
+        # Commit point: every live worker holds the staged snapshot.
+        with self._lock:
+            self._weights = (version, params_host)
+        self._send_weights_ctl("commit", version)
+        ok = self.wait_weights_ack(version, timeout=timeout)
+        self._event("weights-push", (version, ok))
+        return ok
+
     # -- deterministic consumption ---------------------------------------
     def next_item(self, timeout: float = 0.1
                   ) -> Optional[Tuple[PoolMember, Any]]:
@@ -1043,6 +1158,9 @@ class PoolWorkerClient:
         self._weights_cv = threading.Condition(self._lock)
         self._version = -1
         self._params: Any = None
+        #: v7 two-phase push: (version, params) held inactive until the
+        #: learner's commit frame promotes it (abort drops it).
+        self._staged: Optional[Tuple[int, Any]] = None
         self.goodbye = threading.Event()   # learner asked us to leave
         self.closed = threading.Event()    # channel is gone
         self._sent = 0
@@ -1142,17 +1260,50 @@ class PoolWorkerClient:
                     # before the learner enabled tracing adopts on
                     # the first traced WEIGHTS frame instead.
                     self._trc().adopt_trace(self.chan.last_remote_ctx[0])
+                    ack = None
                     with self._weights_cv:
-                        # Latest-wins: a slow worker skips straight to
-                        # the freshest snapshot instead of replaying
-                        # every intermediate version.  A version-only
-                        # frame (no params key: a quarantined update
-                        # changed nothing) advances the tag and keeps
-                        # the current snapshot.
-                        self._version = int(payload["version"])
-                        if "params" in payload:
-                            self._params = payload["params"]
+                        if "commit" in payload:
+                            # v7 commit: promote the staged snapshot.
+                            # A commit for a version we never staged
+                            # (joined mid-push) is ignored — the
+                            # learner's next full broadcast catches us
+                            # up; committing nothing would be worse.
+                            v = int(payload["commit"])
+                            if self._staged is not None and \
+                                    self._staged[0] == v:
+                                self._version, self._params = self._staged
+                                self._staged = None
+                                ack = {"version": v}
+                        elif "abort" in payload:
+                            # Torn push: drop the staged snapshot, keep
+                            # generating on the old params.
+                            v = int(payload["abort"])
+                            if self._staged is not None and \
+                                    self._staged[0] == v:
+                                self._staged = None
+                        elif payload.get("staged"):
+                            # Phase one: hold the snapshot INACTIVE
+                            # until the learner's commit — old params
+                            # stay live across the whole fleet until
+                            # the commit point.
+                            v = int(payload["version"])
+                            self._staged = (v, payload.get("params"))
+                            ack = {"version": v, "staged": True}
+                        else:
+                            # Latest-wins: a slow worker skips straight
+                            # to the freshest snapshot instead of
+                            # replaying every intermediate version.  A
+                            # version-only frame (no params key: a
+                            # quarantined update changed nothing)
+                            # advances the tag and keeps the current
+                            # snapshot.
+                            self._version = int(payload["version"])
+                            if "params" in payload:
+                                self._params = payload["params"]
+                            ack = {"version": self._version}
                         self._weights_cv.notify_all()
+                    if ack is not None:
+                        self.chan.send_frame(FRAME_WEIGHTS_ACK, ack)
                 elif kind == FRAME_ACK:
                     with self._weights_cv:
                         self._acked = max(self._acked,

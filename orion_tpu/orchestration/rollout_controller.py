@@ -1,0 +1,405 @@
+"""Zero-downtime fleet weight rollout (PR 18).
+
+``WeightRolloutCoordinator`` takes a version-tagged param snapshot —
+the same payload the PR 6 WEIGHTS fan-out carries — and rolls it
+through a fleet of :class:`ContinuousBatchingEngine` instances one at
+a time (``cfg.rollout_update.max_concurrent_drains`` caps the overlap)
+so a :class:`ServingGateway` in front of the fleet never loses
+availability.  Each engine walks a blue/green ladder:
+
+    DRAINING  stop admitting on this engine (gateway routes around it,
+              the engine itself sheds direct submits with a typed
+              overload) and let in-flight requests finish.  Past
+              ``drain_deadline_ticks`` the gateway migrates the
+              stragglers to sibling engines with a ``restarted``
+              stream marker, so streamed clients resubscribe
+              transparently and nothing is dropped.
+    RELOAD    swap params via ``engine.reload_weights`` — busts the
+              prep-cache identity check, clears BOTH KV tiers, drains
+              evictions, and bumps ``engine.weight_version`` so any
+              in-flight prefill-tier KV offer against the old weights
+              is refused at admission (stale-offer drop).
+    CANARY    pinned probe requests (fixed ids / fixed synthetic
+              prompts; greedy whenever the serving config is greedy)
+              run to completion on the freshly loaded engine.  Every
+              completion must carry finite logprobs, in-range token
+              ids, and match the recorded fingerprint shape from the
+              first healthy canary.  A failure is a typed
+              :class:`CanaryFailed`.
+    READMIT   drain gate off, gateway admit gate back on.
+
+The fleet-wide commit point is the last engine's READMIT: only then
+does ``coordinator.version`` advance and the retained old params
+become garbage.  Any fault before that — torn push
+(``weights.push``), crash entering drain (``engine.drain``), canary
+rejection (``engine.canary``), or coordinator death mid-fleet (the
+caller simply re-``begin``\\ s with the old snapshot) — triggers an
+automatic rollback that walks the *upgraded* engines back through the
+same ladder onto the retained old params.  A failure during rollback
+gates the sick engine off permanently (it may hold half-loaded
+weights) and the rest of the fleet converges; availability is
+preserved by never gating the last admitting engine.
+
+Everything is tick-counted — no wall clock — so a seeded
+:class:`FaultPlan` replays bit-identically: ``decisions`` is a list of
+primitive tuples and ``counters()`` feeds the gateway's ``rollout_*``
+stats.  ``tick()`` is driven from the gateway pump thread (or directly
+by tests), which is the engines' single owner, so the coordinator may
+step a drained engine synchronously for canary probes.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from orion_tpu import obs
+from orion_tpu.resilience import fault_point
+
+_LOG = logging.getLogger(__name__)
+
+#: Per-engine blue/green ladder, in order.
+STATES = ("DRAINING", "RELOAD", "CANARY", "READMIT")
+
+#: Canary probe request ids live far above anything the gateway or a
+#: direct caller allocates, so they can never collide with client rids.
+PROBE_BASE = 1 << 40
+
+
+class CanaryFailed(RuntimeError):
+    """The canary gate rejected freshly loaded weights."""
+
+
+class WeightRolloutCoordinator:
+    """Blue/green fleet weight rollout with canary gates + rollback."""
+
+    def __init__(self, engines=None, gateway=None, cfg=None,
+                 autopilot=None):
+        if engines is None:
+            if gateway is None:
+                raise ValueError("need engines or a gateway")
+            engines = gateway.engines
+        self.engines = list(engines)
+        if not self.engines:
+            raise ValueError("empty engine fleet")
+        self.gateway = gateway
+        if cfg is None:
+            from orion_tpu.config import RolloutUpdateConfig
+            cfg = RolloutUpdateConfig()
+        self.cfg = cfg
+        self.ticks = 0
+        self.version = 0                  # last committed push version
+        self.decisions: List[tuple] = []  # primitive tuples (replay witness)
+        self.counters_: Dict[str, int] = {
+            "rollout_pushes": 0, "rollout_commits": 0,
+            "rollout_rollbacks": 0, "rollout_drains": 0,
+            "rollout_migrations": 0, "rollout_canary_failures": 0,
+            "rollout_faults": 0, "rollout_engines_gated": 0,
+        }
+        self._lock = threading.Lock()
+        self._pending: Optional[tuple] = None   # staged (version, params)
+        self._roll: Optional[dict] = None
+        self._fingerprint: Optional[dict] = None
+        self._probe_seq = 0
+        if gateway is not None:
+            gateway.rollout = self
+        if autopilot is not None:
+            autopilot.rollout = self
+
+    # ------------------------------------------------------------- API
+
+    @property
+    def active(self) -> bool:
+        with self._lock:
+            return self._roll is not None or self._pending is not None
+
+    def begin(self, params, version: int) -> None:
+        """Stage a version-tagged push; the next ``tick()`` starts the
+        roll.  Thread-safe (a learner thread may call this while the
+        gateway pump owns the engines).  Raises if a roll is already
+        in flight — the caller retries after convergence."""
+        with self._lock:
+            if self._pending is not None or self._roll is not None:
+                raise RuntimeError("weight rollout already in progress")
+            self._pending = (int(version), params)
+
+    def counters(self) -> Dict[str, float]:
+        c = {k: float(v) for k, v in self.counters_.items()}
+        c["rollout_active"] = float(self.active)
+        c["rollout_version"] = float(self.version)
+        return c
+
+    def tick(self) -> bool:
+        """Advance the roll by one step.  Called from the engine-owner
+        thread.  Returns True when the coordinator did any work."""
+        with self._lock:
+            pending = self._pending
+            self._pending = None
+        if pending is not None:
+            self._start(*pending)
+        if self._roll is None:
+            return pending is not None
+        self.ticks += 1
+        self._advance()
+        return True
+
+    # ------------------------------------------------- roll lifecycle
+
+    def _decide(self, what: str, detail) -> None:
+        self.decisions.append((self.ticks, what, detail))
+
+    def _transition(self, idx: int, frm, to) -> None:
+        self._decide("state", (idx, frm, to))
+        obs.instant("rollout.state", engine=idx, frm=str(frm), to=str(to))
+
+    def _start(self, version: int, params) -> None:
+        self.counters_["rollout_pushes"] += 1
+        # Retain every engine's live params until the fleet-wide
+        # commit point: these are the rollback targets.
+        old = {i: e.params_snapshot() for i, e in enumerate(self.engines)}
+        self._roll = {
+            "version": version, "params": params,
+            "old": old, "old_version": self.version,
+            "queue": list(range(len(self.engines))),
+            "cycles": [], "upgraded": [], "failed": [],
+            "rolling_back": False,
+        }
+        self._decide("push", version)
+        obs.flight_dump("rollout-start",
+                        {"version": version, "fleet": len(self.engines)})
+
+    def _advance(self) -> None:
+        r = self._roll
+        while (r["queue"] and
+               len(r["cycles"]) < self.cfg.max_concurrent_drains and
+               self._can_gate(r["queue"][0])):
+            idx = r["queue"].pop(0)
+            try:
+                self._enter_drain(idx)
+            except Exception as exc:  # noqa: BLE001 — fault boundary
+                self._cycle_failed(idx, "DRAINING", exc)
+                return
+        for cyc in list(r["cycles"]):
+            if self._roll is not r or cyc not in r["cycles"]:
+                return          # roll was rebuilt (rollback) mid-loop
+            try:
+                self._advance_cycle(cyc)
+            except Exception as exc:  # noqa: BLE001 — fault boundary
+                self._cycle_failed(cyc["idx"], cyc["state"], exc)
+                return
+        r = self._roll
+        if r is not None and not r["queue"] and not r["cycles"]:
+            self._finish()
+
+    def _can_gate(self, idx: int) -> bool:
+        """Never gate the last admitting engine (availability floor);
+        a single-engine fleet accepts the pause, and re-gating an
+        already-gated engine (rollback re-entry) is always free."""
+        if self.gateway is None or len(self.engines) == 1:
+            return True
+        if not self.gateway.engine_admitting(idx):
+            return True
+        admitting = sum(self.gateway.engine_admitting(i)
+                        for i in range(len(self.engines)))
+        return admitting > 1
+
+    def _enter_drain(self, idx: int) -> None:
+        fault_point("engine.drain")
+        eng = self.engines[idx]
+        eng.drain(True)
+        if self.gateway is not None:
+            self.gateway.set_engine_admit(idx, False)
+        self._roll["cycles"].append(
+            {"idx": idx, "state": "DRAINING", "ticks": 0,
+             "migrated": False})
+        self.counters_["rollout_drains"] += 1
+        self._transition(idx, None, "DRAINING")
+
+    def _advance_cycle(self, cyc: dict) -> None:
+        idx = cyc["idx"]
+        eng = self.engines[idx]
+        cyc["ticks"] += 1
+        if cyc["state"] != "DRAINING":      # ladder runs drain→readmit
+            raise RuntimeError(f"corrupt cycle state {cyc['state']!r}")
+        if eng.pending:
+            if (not cyc["migrated"] and self.gateway is not None and
+                    cyc["ticks"] > self.cfg.drain_deadline_ticks):
+                moved = self.gateway.migrate_engine_requests(idx)
+                cyc["migrated"] = True
+                self.counters_["rollout_migrations"] += moved
+                self._decide("migrate", (idx, moved))
+            return                           # keep draining
+        # Drained: reload + canary + readmit in one tick — the engine
+        # is idle and we own it, so there is nothing to interleave.
+        self._transition(idx, "DRAINING", "RELOAD")
+        cyc["state"] = "RELOAD"
+        self._do_reload(cyc)
+        self._transition(idx, "RELOAD", "CANARY")
+        cyc["state"] = "CANARY"
+        self._do_canary(cyc)
+        self._readmit(cyc)
+
+    def _do_reload(self, cyc: dict) -> None:
+        fault_point("weights.push")
+        r = self._roll
+        idx = cyc["idx"]
+        target = r["old"][idx] if r["rolling_back"] else r["params"]
+        if target is None:
+            raise RuntimeError(f"engine {idx} has no rollback snapshot")
+        wv = self.engines[idx].reload_weights(target)
+        self._decide("reload", (idx, wv))
+
+    def _do_canary(self, cyc: dict) -> None:
+        fault_point("engine.canary")
+        idx = cyc["idx"]
+        if self.cfg.canary_prompts <= 0:
+            self._decide("canary", (idx, "skipped"))
+            return
+        results = self._run_probes(self.engines[idx])
+        self._check_canary(idx, results)
+        self._decide("canary", (idx, "ok"))
+
+    def _run_probes(self, eng) -> List[Any]:
+        """Run pinned synthetic probes on a drained engine.  We are on
+        the engine-owner thread, so toggling the drain gate around the
+        probe submits is race-free."""
+        plen = max(1, min(8, eng.cfg.max_prompt_len))
+        budget = max(1, min(self.cfg.canary_budget, eng.cfg.max_new_tokens))
+        vocab = int(eng.mc.vocab_size)
+        probes = []
+        eng.drain(False)
+        try:
+            for i in range(self.cfg.canary_prompts):
+                pid = PROBE_BASE + self._probe_seq
+                self._probe_seq += 1
+                ids = ((np.arange(plen, dtype=np.int64) * 7919 + 13 * i)
+                       % max(1, vocab - 1)) + 1
+                eng.submit(pid, ids.astype(np.int32), budget=budget,
+                           logprobs=True)
+                probes.append(pid)
+            done: Dict[int, Any] = {}
+            guard = 64 * budget + 64 * plen + 256
+            while eng.pending:
+                for comp in eng.step():
+                    done[comp.req_id] = comp
+                guard -= 1
+                if guard <= 0:
+                    raise CanaryFailed("canary probes did not complete")
+            try:
+                return [done[p] for p in probes]
+            except KeyError as exc:
+                raise CanaryFailed(f"canary probe lost: {exc}") from exc
+        finally:
+            eng.drain(True)
+
+    def _check_canary(self, idx: int, results: List[Any]) -> None:
+        fp = {"probes": len(results)}
+        vocab = int(self.engines[idx].mc.vocab_size)
+        for comp in results:
+            toks = np.asarray(comp.tokens)
+            lps = np.asarray(comp.logprobs)
+            if toks.size < 1:
+                raise CanaryFailed("canary produced no tokens")
+            if lps.shape != toks.shape:
+                raise CanaryFailed(
+                    f"logprob shape {lps.shape} != tokens {toks.shape}")
+            if not np.all(np.isfinite(lps)):
+                raise CanaryFailed("non-finite logprobs from new weights")
+            if toks.min() < 0 or toks.max() >= vocab:
+                raise CanaryFailed("canary token id out of vocab range")
+        fp["tok_dtype"] = str(np.asarray(results[0].tokens).dtype)
+        fp["lp_dtype"] = str(np.asarray(results[0].logprobs).dtype)
+        if self._fingerprint is None:
+            self._fingerprint = fp      # recorded at first healthy canary
+        elif fp != self._fingerprint:
+            raise CanaryFailed(
+                f"canary fingerprint drift: {fp} != {self._fingerprint}")
+
+    def _readmit(self, cyc: dict) -> None:
+        r = self._roll
+        idx = cyc["idx"]
+        self._transition(idx, "CANARY", "READMIT")
+        self.engines[idx].drain(False)
+        if self.gateway is not None:
+            self.gateway.set_engine_admit(idx, True)
+        r["cycles"].remove(cyc)
+        if not r["rolling_back"]:
+            r["upgraded"].append(idx)
+        self._decide("readmit", idx)
+
+    def _finish(self) -> None:
+        r, self._roll = self._roll, None
+        if r["rolling_back"]:
+            self.version = r["old_version"]
+            self._decide("rolled-back", (self.version, tuple(r["failed"])))
+            obs.flight_dump("rollout-rollback-complete",
+                            {"version": self.version,
+                             "gated": list(r["failed"])})
+        elif r["failed"]:                # halt policy stopped the roll
+            self._decide("halted", (r["version"], tuple(r["failed"])))
+            obs.flight_dump("rollout-halted",
+                            {"version": r["version"],
+                             "gated": list(r["failed"]),
+                             "upgraded": list(r["upgraded"])})
+        else:                            # fleet-wide commit point
+            self.version = r["version"]
+            self.counters_["rollout_commits"] += 1
+            self._decide("commit", self.version)
+            obs.flight_dump("rollout-commit", {"version": self.version})
+
+    # ------------------------------------------------- fault handling
+
+    def _cycle_failed(self, idx: int, state: str, exc: Exception) -> None:
+        r = self._roll
+        self.counters_["rollout_faults"] += 1
+        if isinstance(exc, CanaryFailed) or state == "CANARY":
+            self.counters_["rollout_canary_failures"] += 1
+        self._decide("fault", (idx, state, type(exc).__name__))
+        obs.flight_dump("rollout-fault",
+                        {"engine": idx, "state": state, "exc": repr(exc),
+                         "rolling_back": r["rolling_back"]})
+        _LOG.error("rollout fault on engine %d in %s: %r", idx, state, exc)
+        if r["rolling_back"] or self.cfg.rollback_policy == "halt":
+            # Rollback itself failed (or the operator asked us not to
+            # roll back): gate the sick engine off permanently — it
+            # may hold half-loaded weights — and let the rest of the
+            # fleet converge.
+            self._gate_off(idx)
+            r["cycles"] = [c for c in r["cycles"] if c["idx"] != idx]
+            r["failed"].append(idx)
+            if not r["rolling_back"]:
+                r["queue"] = []          # halt: stop upgrading
+        else:
+            self._begin_rollback(idx)
+
+    def _gate_off(self, idx: int) -> None:
+        self.counters_["rollout_engines_gated"] += 1
+        try:
+            self.engines[idx].drain(True)
+        except Exception:  # noqa: BLE001 — engine may be wrecked
+            pass
+        if self.gateway is not None:
+            self.gateway.set_engine_admit(idx, False)
+        self._decide("gate-off", idx)
+
+    def _begin_rollback(self, failed_idx: int) -> None:
+        r = self._roll
+        # Every engine that holds (or may hold) the new weights walks
+        # the ladder again onto the retained old params.  Engines
+        # mid-cycle are included even if they never swapped — a
+        # redundant reload of old params just forces a clean slate.
+        targets = sorted(set(r["upgraded"]) |
+                         {c["idx"] for c in r["cycles"]} | {failed_idx})
+        self.counters_["rollout_rollbacks"] += 1
+        self._decide("rollback", (r["version"], tuple(targets)))
+        obs.flight_dump("rollout-rollback",
+                        {"version": r["version"], "targets": targets})
+        self._roll = {
+            "version": r["old_version"], "params": None,
+            "old": r["old"], "old_version": r["old_version"],
+            "queue": targets, "cycles": [], "upgraded": [],
+            "failed": r["failed"], "rolling_back": True,
+        }

@@ -46,6 +46,9 @@ FAULT_POINTS = frozenset({
     "controller.decide",  # SLO autopilot decision tick
     "kv.spill",           # device->host KV tier spill of an evicted page
     "kv.handoff",         # prefill-tier KV page injection on the decode side
+    "weights.push",       # fleet rollout: per-engine param swap (torn push)
+    "engine.drain",       # fleet rollout: blue/green drain entry
+    "engine.canary",      # fleet rollout: canary probe gate before readmit
 })
 
 

@@ -399,6 +399,14 @@ class ContinuousBatchingEngine:
         self._cancels: set = set()       # deferred (mid-prefill) aborts
         self.shed_requests = 0           # EngineOverloaded refusals
         self.cancelled_requests = 0
+        # -- blue/green weight rollout (PR 18) -------------------------
+        # weight_version counts distinct snapshots installed (every
+        # _prep_params identity-cache MISS); the prefill tier stamps
+        # its KV offers with it so pages computed under old weights are
+        # dropped instead of injected after a reload.  _draining gates
+        # submit() while the rollout coordinator cycles this engine.
+        self._weight_version = 0
+        self._draining = False
         # -- adaptive-k host state (speculative v2) --------------------
         # Two signals drive the per-wave verify decision:
         # (1) DRAFTABILITY — each segment program reports, per slot,
@@ -557,6 +565,7 @@ class ContinuousBatchingEngine:
         self.sched.drain_evictions()
         if self._host_cache is not None:
             self._host_cache.clear()
+        self._weight_version += 1
         return out
 
     def load_weights(self, params) -> None:
@@ -565,6 +574,48 @@ class ContinuousBatchingEngine:
         every decode step reads 2 bytes/param instead of 4 (int8 when
         quantize_weights is on)."""
         self._params = self._prep_params(params)
+
+    # -- blue/green rollout surface (PR 18) ------------------------------
+    @property
+    def weight_version(self) -> int:
+        """Monotonic count of distinct snapshots installed.  Anything
+        derived from the weights (prefill-tier KV offers) records it
+        at creation and is invalid once it moves."""
+        return self._weight_version
+
+    def params_snapshot(self):
+        """The raw param tree last handed to :meth:`load_weights` —
+        what the rollout coordinator retains as the rollback target
+        until the fleet-wide commit point."""
+        return getattr(self, "_prep_src", None)
+
+    def reload_weights(self, params) -> int:
+        """Forced param swap for the blue/green RELOAD step: busts the
+        identity cache first, so even re-installing the IDENTICAL tree
+        object (the rollback path) takes the full reload path — cast /
+        quantize, BOTH KV tiers cleared, eviction backlog drained,
+        version bumped.  Returns the new :attr:`weight_version`."""
+        self._prep_src = None
+        self._params = self._prep_params(params)
+        return self._weight_version
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    def drain(self, on: bool = True) -> None:
+        """Blue/green admission gate: while draining, ``submit`` sheds
+        with a typed :class:`EngineOverloaded` (callers route to
+        another engine or retry after the drain).  In-flight requests
+        keep decoding — the pump must keep calling ``step`` until
+        :attr:`pending` hits zero."""
+        self._draining = bool(on)
+
+    def inflight_ids(self) -> List[int]:
+        """Ids of every request submitted but not yet completed
+        (waiting, prefilling, or decoding) — the migration set when a
+        drain hits its deadline."""
+        return sorted(self._reqinfo)
 
     @staticmethod
     def _bucket(n: int, cap: int) -> int:
@@ -1462,6 +1513,13 @@ class ContinuousBatchingEngine:
         # queue cap, then the rate bucket (a queue-refused submit must
         # not burn rate tokens).
         total_waiting = sum(self._tenant_queued.values())
+        if self._draining:
+            # Blue/green drain: a typed shed, not an error — the
+            # gateway routes around a draining engine, and a direct
+            # caller backs off exactly like any other overload.
+            self._shed(
+                "engine draining for weight rollout",
+                total_waiting, self._retry_after_hint(), name)
         if cfg.max_queued_requests and \
                 total_waiting + k > cfg.max_queued_requests:
             self._shed(

@@ -601,6 +601,145 @@ def run_autopilot_arm(seed):
     }
 
 
+def run_weight_rollout_arm(seed):
+    """Zero-downtime fleet weight-rollout arm (ISSUE 18): a paid
+    tenant rides a fixed submit-wave trace twice on a TWO-engine tiny
+    fleet — uncontended, then with a free-tenant flood AND a full
+    blue/green weight roll (drain → reload → canary → readmit per
+    engine) fired mid-trace by the WeightRolloutCoordinator.  Submits
+    route to the least-pending non-draining engine, exactly the
+    gateway's deterministic policy.  TTFT is in WAVES (seed-
+    deterministic, like the autopilot arm); the recorded number is
+    the paid p95 ratio roll-run / uncontended (floored at 2 waves;
+    lower is better) — a coordinator regression that stops routing
+    around the draining engine, or lets the canary stall the fleet,
+    shows up directly as ratio growth.  Always the tiny CPU shape:
+    the arm measures the CONTROL PATH, not model throughput."""
+    from orion_tpu.config import ModelConfig, RolloutConfig
+    from orion_tpu.models import Transformer, init_params
+    from orion_tpu.orchestration.rollout_controller import (
+        WeightRolloutCoordinator)
+    from orion_tpu.rollout.continuous import (ContinuousBatchingEngine,
+                                              EngineOverloaded)
+
+    W, paid_every, flood_per = 48, 2, 4
+    flood = range(10, 30)
+    roll_wave = 12
+
+    mc = ModelConfig.tiny(dtype="float32")
+    model = Transformer(mc)
+    params = init_params(model, jax.random.key(0), mc)
+    new_params = jax.tree_util.tree_map(lambda x: x * 1.001, params)
+
+    def mk_engine(rank):
+        eng = ContinuousBatchingEngine(
+            model, mc, RolloutConfig(
+                max_prompt_len=32, max_new_tokens=8, temperature=0.0,
+                max_batch_size=4, page_size=4, segment_len=4),
+            eos_token_id=None, pad_token_id=0)
+        eng.load_weights(params)
+        eng.reset_rng(jax.random.key(17 + rank))
+        eng.configure_tenant("paid", weight=8)
+        eng.configure_tenant("free", weight=1)
+        return eng
+
+    def trace(roll):
+        fleet = [mk_engine(0), mk_engine(1)]
+        rng = np.random.RandomState(seed)
+        frng = np.random.RandomState(seed + 1)
+        paid = {w: rng.randint(1, 40, size=6 + (w % 5)).astype(np.int32)
+                for w in range(0, W, paid_every)}
+        flood_p = {(w, j): frng.randint(1, 40, size=8).astype(np.int32)
+                   for w in flood for j in range(flood_per)}
+        wave_now = [0]
+        submit_wave, ttft = {}, {}
+        co = WeightRolloutCoordinator(engines=fleet) if roll else None
+        refused = 0
+
+        def mk_cb(rid):
+            def cb(chunk):
+                if rid not in ttft and len(chunk.tokens):
+                    ttft[rid] = wave_now[0] - submit_wave[rid]
+            return cb
+
+        def route(rid, ids, budget, tenant, cb=None):
+            # the gateway's policy: least-pending non-draining engine
+            order = sorted((i for i, e in enumerate(fleet)
+                            if not e.draining),
+                           key=lambda i: (fleet[i].pending, i))
+            for i in order:
+                try:
+                    fleet[i].submit(rid, ids, budget=budget,
+                                    tenant=tenant, stream=cb is not None,
+                                    on_tokens=cb)
+                    return True
+                except EngineOverloaded:
+                    continue
+            return False
+
+        for w in range(W):
+            wave_now[0] = w
+            if roll and w == roll_wave:
+                co.begin(new_params, version=1)
+            if w in paid:
+                rid = 1000 + w
+                submit_wave[rid] = w
+                if not route(rid, paid[w], 4, "paid", mk_cb(rid)):
+                    refused += 1
+            if roll and w in flood:
+                for j in range(flood_per):
+                    if not route(2000 + 10 * w + j, flood_p[(w, j)],
+                                 8, "free"):
+                        refused += 1
+            if co is not None:
+                co.tick()
+            for eng in fleet:
+                if eng.pending:
+                    eng.step()
+        extra = 0
+        while (any(e.pending for e in fleet)
+               or (co is not None and co.active)) and extra < 200:
+            wave_now[0] += 1
+            if co is not None:
+                co.tick()
+            for eng in fleet:
+                if eng.pending:
+                    eng.step()
+            extra += 1
+        stats = {"ttft": [float(ttft[r]) for r in sorted(ttft)],
+                 "refused": refused}
+        if co is not None:
+            stats["counters"] = co.counters()
+        return stats
+
+    def p95(xs):
+        xs = sorted(xs)
+        return float(xs[max(0, int(np.ceil(0.95 * len(xs))) - 1)])
+
+    base = trace(False)
+    r = trace(True)
+    c = r["counters"]
+    assert c["rollout_commits"] == 1.0, c  # the roll must finish
+    return {
+        "weight_rollout_paid_ttft_p95_waves_base": round(
+            p95(base["ttft"]), 4),
+        "weight_rollout_paid_ttft_p95_waves_roll": round(
+            p95(r["ttft"]), 4),
+        # quantization floor on BOTH sides (sub-wave resolution does
+        # not exist in this unit): a healthy roll reads 1.0 — the
+        # fleet routed around every drain and paid TTFT never moved —
+        # and only a real regression (canary stall, routing loss)
+        # pushes the numerator off the floor
+        "weight_rollout_p95_ratio": round(
+            max(p95(r["ttft"]), 2.0) / max(p95(base["ttft"]), 2.0), 4),
+        "weight_rollout_commits": c["rollout_commits"],
+        "weight_rollout_drains": c["rollout_drains"],
+        "weight_rollout_canary_failures": c["rollout_canary_failures"],
+        "weight_rollout_refused_submits": r["refused"],
+        "weight_rollout_paid_served": len(r["ttft"]),
+    }
+
+
 def serve_dense(dense, sh, prompts, budgets, arrivals):
     """Static fixed-batch serving: collect arrived requests, and when a
     full batch of B is waiting (or the trace has drained), decode the
@@ -966,6 +1105,10 @@ def run(sh=None, seed=None, record=True):
     # Closed-loop SLO autopilot (PR 13): chaos-vs-uncontended
     # paid-tenant TTFT with the controller active, tiny shape always.
     out.update(run_autopilot_arm(seed))
+
+    # Zero-downtime fleet weight rollout (ISSUE 18): paid-tenant TTFT
+    # through a mid-trace blue/green roll vs uncontended, tiny shape.
+    out.update(run_weight_rollout_arm(seed))
     if record:
         self_path = os.path.join(os.path.dirname(os.path.dirname(
             os.path.abspath(__file__))), "BENCH_SELF.json")
@@ -976,6 +1119,7 @@ def run(sh=None, seed=None, record=True):
         stream_key = f"streaming_ttft_p95_{sh['model']}"
         tier_key = f"ragged_tiered_cache_toks_per_sec_{sh['model']}"
         auto_key = "autopilot_p95_recovery_tiny"
+        roll_key = "weight_rollout_p95_ratio_tiny"
         base = {}
         if os.path.exists(self_path):
             with open(self_path) as f:
@@ -1022,6 +1166,14 @@ def run(sh=None, seed=None, record=True):
             # model-independent.
             base[auto_key] = out["autopilot_p95_recovery"]
             changed = True
+        if roll_key not in base:
+            # Fleet weight-rollout regression row (ISSUE 18; lower is
+            # better): paid-tenant TTFT p95 ratio through a mid-trace
+            # blue/green roll + flood vs uncontended, with the
+            # coordinator routing around each draining engine.  Tiny
+            # control-path shape, so the key is model-independent.
+            base[roll_key] = out["weight_rollout_p95_ratio"]
+            changed = True
         if changed:
             with open(self_path, "w") as f:
                 json.dump(base, f, indent=1)
@@ -1042,6 +1194,9 @@ def run(sh=None, seed=None, record=True):
         out["autopilot_recovery_vs_baseline"] = \
             round(out["autopilot_p95_recovery"] / base[auto_key], 4) \
             if base.get(auto_key) else 1.0
+        out["weight_rollout_vs_baseline"] = \
+            round(out["weight_rollout_p95_ratio"] / base[roll_key], 4) \
+            if base.get(roll_key) else 1.0
     print(json.dumps(out))
     return out
 

@@ -734,6 +734,43 @@ FIXTURES = [
         None,
     ),
     (
+        # ISSUE 18: the v7 WEIGHTS-commit handshake adds WEIGHTS_ACK
+        # to the pool family — a learner recv chain that handles the
+        # push frames but silently eats the ACK (so staged pushes
+        # never confirm and every rollout would hang at the commit
+        # barrier) must fire; the same chain with the ACK branch and
+        # a loud else stays clean.
+        "frame-exhaustive",
+        """
+        FRAME_HEARTBEAT = 2
+        FRAME_WEIGHTS = 4
+        FRAME_WEIGHTS_ACK = 7
+
+        def learner_dispatch(kind, payload):
+            if kind == FRAME_HEARTBEAT:
+                return None
+            elif kind == FRAME_WEIGHTS:
+                return ("push", payload)
+            # WEIGHTS_ACK silently dropped: staged commit never lands
+        """,
+        """
+        FRAME_HEARTBEAT = 2
+        FRAME_WEIGHTS = 4
+        FRAME_WEIGHTS_ACK = 7
+
+        def learner_dispatch(kind, payload):
+            if kind == FRAME_HEARTBEAT:
+                return None
+            elif kind == FRAME_WEIGHTS:
+                return ("push", payload)
+            elif kind == FRAME_WEIGHTS_ACK:
+                return ("acked", payload)
+            else:
+                raise ValueError(f"unexpected frame {kind}")
+        """,
+        "wire_ack.py",
+    ),
+    (
         # header format drifted from the registered PROTOCOL_VERSION
         # entry (the PR 9 v3-to-v4 rule, structurally checked)
         "frame-exhaustive",
@@ -813,6 +850,42 @@ FIXTURES = [
             "server.py": """
             def serve(cfg):
                 return cfg.port
+            """,
+        },
+        None,
+    ),
+    (
+        # ISSUE 18: the rollout_update knob family — a blue/green
+        # coordinator that stops reading one of its ladder knobs
+        # (drain deadline silently hardcoded) is drift; reading every
+        # knob outside the config module is clean.
+        "config-drift",
+        {
+            "rollcfg.py": """
+            import dataclasses
+
+            @dataclasses.dataclass
+            class RolloutUpdateConfig:
+                canary_prompts: int = 2
+                drain_deadline_ticks: int = 200
+            """,
+            "coordinator.py": """
+            def advance(cfg):
+                return cfg.canary_prompts
+            """,
+        },
+        {
+            "rollcfg.py": """
+            import dataclasses
+
+            @dataclasses.dataclass
+            class RolloutUpdateConfig:
+                canary_prompts: int = 2
+                drain_deadline_ticks: int = 200
+            """,
+            "coordinator.py": """
+            def advance(cfg):
+                return cfg.canary_prompts + cfg.drain_deadline_ticks
             """,
         },
         None,
