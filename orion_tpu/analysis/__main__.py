@@ -12,15 +12,35 @@ that keeps repeated runs fast as the tree grows.
 from __future__ import annotations
 
 import argparse
+import subprocess
 import sys
+import time
 from typing import List, Optional
 
-from orion_tpu.analysis.engine import analyze_paths, default_cache_path
+from orion_tpu.analysis.engine import (analyze_paths, default_cache_path,
+                                       fix_suppressions)
 from orion_tpu.analysis.report import (apply_baseline, format_findings,
                                        format_json, format_rule_table,
                                        format_sarif, load_baseline,
                                        write_baseline)
 from orion_tpu.analysis.rules import RULES
+
+
+def _git_changed_files() -> Optional[List[str]]:
+    """``.py`` files changed vs ``git merge-base HEAD main``, plus
+    untracked ones; None when git/main is unavailable (the caller
+    reports the usage error)."""
+    def run(*cmd: str) -> str:
+        return subprocess.run(["git", *cmd], capture_output=True,
+                              text=True, check=True).stdout
+    try:
+        base = run("merge-base", "HEAD", "main").strip()
+        names = run("diff", "--name-only", base).splitlines()
+        names += run("ls-files", "--others",
+                     "--exclude-standard").splitlines()
+    except (OSError, subprocess.CalledProcessError):
+        return None
+    return [p for p in names if p.endswith(".py")]
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -58,6 +78,20 @@ def main(argv: Optional[List[str]] = None) -> int:
                              "~/.cache/orion-tpu-analysis-<cwd>.json)")
     parser.add_argument("--no-cache", action="store_true",
                         help="disable the per-file result cache")
+    parser.add_argument("--changed", action="store_true",
+                        help="run the per-file phase only on files "
+                             "changed vs `git merge-base HEAD main` "
+                             "(plus untracked files); the project "
+                             "phase still sees the full tree, so "
+                             "project-rule findings match a full run")
+    parser.add_argument("--stats", action="store_true",
+                        help="print a one-line run summary (rules run, "
+                             "findings, cache hit rate, wall) to "
+                             "stderr")
+    parser.add_argument("--fix-suppressions", action="store_true",
+                        help="delete stale '# orion: ignore[...]' "
+                             "comments in place (comment-token "
+                             "surgery) and exit")
     args = parser.parse_args(argv)
 
     if args.list_rules:
@@ -68,6 +102,18 @@ def main(argv: Optional[List[str]] = None) -> int:
                      "orion_tpu tests scripts)")
     if args.update_baseline and not args.baseline:
         parser.error("--update-baseline requires --baseline FILE")
+
+    if args.fix_suppressions:
+        try:
+            edits = fix_suppressions(args.paths)
+        except FileNotFoundError as e:
+            print(str(e), file=sys.stderr)
+            return 2
+        for path, line in edits:
+            print(f"fixed: {path}:{line}")
+        print(f"{len(edits)} stale suppression"
+              f"{'s' if len(edits) != 1 else ''} removed")
+        return 0
 
     rules = None
     if args.rule:
@@ -91,14 +137,38 @@ def main(argv: Optional[List[str]] = None) -> int:
                          "rules) — a run that checks nothing must not "
                          "report clean")
 
+    changed: Optional[List[str]] = None
+    if args.changed:
+        changed = _git_changed_files()
+        if changed is None:
+            print("--changed: cannot compute `git merge-base HEAD "
+                  "main` (not a git checkout, or no main branch)",
+                  file=sys.stderr)
+            return 2
+
     cache_path = None if args.no_cache else \
         (args.cache or default_cache_path())
+    stats: dict = {}
+    t0 = time.monotonic()
     try:
         findings = analyze_paths(args.paths, rules=rules,
-                                 cache_path=cache_path)
+                                 cache_path=cache_path,
+                                 file_phase_paths=changed,
+                                 stats=stats)
     except FileNotFoundError as e:
         print(str(e), file=sys.stderr)
         return 2
+    if args.stats:
+        # the --stats wall field deliberately times the run itself
+        wall = time.monotonic() - t0  # orion: ignore[naked-timer]
+        lookups = stats.get("cache_lookups", 0)
+        hits = stats.get("cache_hits", 0)
+        rate = f"{100.0 * hits / lookups:.0f}%" if lookups else "n/a"
+        print(f"stats: files={stats.get('files', 0)} "
+              f"rules={stats.get('rules', 0)} "
+              f"findings={stats.get('findings', 0)} "
+              f"cache={hits}/{lookups} ({rate}) "
+              f"wall={wall:.2f}s", file=sys.stderr)
 
     if args.update_baseline:
         try:

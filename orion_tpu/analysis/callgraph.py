@@ -1,0 +1,992 @@
+"""Phase 3: conservative interprocedural call graph + dataflow rules.
+
+Phases 1/2 reason about one module or one class at a time; the bug
+classes the PR 6-18 hardening rounds actually fought are
+*interprocedural* — a pump helper three calls deep blocking on a
+channel, a gateway/pool lock inversion spanning two modules, a
+``tenant_<t>_*`` telemetry key produced by one subsystem and silently
+dropped by another, a fault point that rotted into untested chaos
+surface.  This module builds ONE :class:`CallGraph` per
+:class:`~orion_tpu.analysis.project.ProjectContext` and registers four
+project rules on top of it:
+
+``lock-order``
+    Global lock-acquisition digraph (every ``self.X = threading.Lock/
+    RLock/Condition`` attr plus module-level locks); an edge A->B means
+    some call chain acquires B while holding A.  Any cycle over >= 2
+    distinct locks is a deadlock candidate; the finding message names
+    the full witness chain (which method holds which lock and which
+    call reaches the nested acquisition).
+
+``blocking-in-pump``
+    Blocking primitives — ``time.sleep``, unbounded ``.join()`` /
+    ``.wait()`` / ``Queue.get()``, any ``.recv()`` — reachable from a
+    single-threaded pump root (``step``/``tick``/``maybe_tick``/
+    ``pump`` methods of ``orchestration/`` and ``rollout/`` classes) or
+    a ``signal.signal`` handler.  The message names the root and the
+    full call chain to the blocking site.
+
+``telemetry-drift``
+    The string-key universe produced by ``server_stats()`` /
+    ``telemetry.summary()`` / ``MetricsWriter`` histogram expansion vs.
+    the keys ``SignalReader``, tests and bench scripts consume: a
+    consumed key nothing produces, or a produced counter nothing reads
+    (or even mentions) anywhere else, is drift.  F-string keys
+    (``f"tenant_{t}_{m}"``) become prefix/suffix patterns and match
+    ``startswith``/``endswith`` pattern consumers.
+
+``fault-coverage``
+    Every name in the ``FAULT_POINTS`` registry must be fired by a
+    ``fault_point(...)`` call site in library code AND exercised by at
+    least one test/bench plan spec (a ``FaultPlan`` dict key or a
+    ``"point:at=..."`` spec string); a typo'd ``fault_point`` literal
+    is flagged at the call site.
+
+Conservatism contract (shared by every rule here): call resolution is
+*under-approximate by construction* — ``self.m()`` resolves within the
+class (plus project-defined bases), bare names resolve to the same
+module or a project-wide unique definition, and ``obj.m()`` resolves
+only when exactly one project class defines ``m``.  Ambiguous calls
+produce no edge, nested ``def``/``lambda`` bodies are separate (never
+inlined into the enclosing frame), and dynamically dispatched
+callables (``self.spawn_fn()``) are invisible.  Reachability IS
+control-flow-insensitive: a blocking call behind a dead ``if False:``
+branch still counts (precision here would need evaluation, and a
+conservative flag on dead code is cheap to suppress).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from orion_tpu.analysis.engine import Finding, ModuleContext, is_test_path
+from orion_tpu.analysis.project import (ClassInfo, ProjectContext,
+                                        _LOCK_CTORS, _assign_targets_value,
+                                        project_rule)
+
+
+def _path_parts(path: str) -> List[str]:
+    return path.replace("\\", "/").split("/")
+
+
+def _is_bench_or_script(path: str) -> bool:
+    parts = _path_parts(path)
+    return "scripts" in parts[:-1] or parts[-1].startswith("bench")
+
+
+def _is_library(path: str) -> bool:
+    return not is_test_path(path) and not _is_bench_or_script(path)
+
+
+class FuncNode:
+    """One function definition the graph knows: a class method (``cls``
+    set) or a module-level function."""
+
+    __slots__ = ("ctx", "cls", "node", "name", "qual", "key")
+
+    def __init__(self, ctx: ModuleContext, cls: Optional[ClassInfo],
+                 node: ast.AST):
+        self.ctx = ctx
+        self.cls = cls
+        self.node = node
+        self.name = node.name
+        self.qual = f"{cls.name}.{node.name}" if cls else node.name
+        self.key = f"{ctx.path}::{self.qual}"
+
+
+class CallGraph:
+    """Project-wide call graph with lazy per-node call-site resolution
+    and acquired-lock context propagation (see the module docstring for
+    the resolution/conservatism contract)."""
+
+    def __init__(self, project: ProjectContext):
+        self.project = project
+        self.nodes: Dict[str, FuncNode] = {}
+        # name -> candidate definers, used for the unique-resolution arms
+        self._methods: Dict[str, List[FuncNode]] = {}
+        self._module_funcs: Dict[str, Dict[str, FuncNode]] = {}
+        self._global_funcs: Dict[str, List[FuncNode]] = {}
+        #: path -> {name: lock_id} for module-level lock assignments
+        self.module_locks: Dict[str, Dict[str, str]] = {}
+        self._callsites: Dict[str, List[Tuple[FuncNode, int]]] = {}
+        self._lock_events: Dict[str, Tuple[list, list]] = {}
+        self._lock_summaries: Optional[Dict[str, Dict[str, Tuple]]] = None
+        for m in project.modules:
+            funcs: Dict[str, FuncNode] = {}
+            for stmt in m.tree.body:
+                if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    fn = FuncNode(m, None, stmt)
+                    funcs[fn.name] = fn
+                    self.nodes[fn.key] = fn
+                    self._global_funcs.setdefault(fn.name, []).append(fn)
+                else:
+                    targets, value = _assign_targets_value(stmt)
+                    if isinstance(value, ast.Call) and \
+                            m.dotted(value.func) in _LOCK_CTORS:
+                        base = _path_parts(m.path)[-1].rsplit(".", 1)[0]
+                        for t in targets:
+                            if isinstance(t, ast.Name):
+                                self.module_locks.setdefault(m.path, {})[
+                                    t.id] = f"{base}.{t.id}"
+            self._module_funcs[m.path] = funcs
+        for info in project.classes:
+            for meth in info.methods.values():
+                fn = FuncNode(info.ctx, info, meth)
+                self.nodes[fn.key] = fn
+                self._methods.setdefault(fn.name, []).append(fn)
+
+    # -- resolution ----------------------------------------------------
+    def _lookup_method(self, info: ClassInfo, name: str,
+                       _seen: Optional[Set[str]] = None
+                       ) -> Optional[FuncNode]:
+        """``self.<name>`` in ``info``: own method, else walk project-
+        defined bases (leaf-name resolution, unique classes only)."""
+        if name in info.methods:
+            return self.nodes.get(
+                f"{info.ctx.path}::{info.name}.{name}")
+        seen = _seen or set()
+        for base in info.bases:
+            leaf = base.split(".")[-1]
+            if not leaf or leaf in seen:
+                continue
+            seen.add(leaf)
+            owners = self.project.classes_by_name.get(leaf, [])
+            if len(owners) == 1:
+                hit = self._lookup_method(owners[0], name, seen)
+                if hit is not None:
+                    return hit
+        return None
+
+    def resolve_call(self, fn: FuncNode, call: ast.Call
+                     ) -> Optional[FuncNode]:
+        func = call.func
+        if isinstance(func, ast.Attribute):
+            if isinstance(func.value, ast.Name) and \
+                    func.value.id == "self" and fn.cls is not None:
+                return self._lookup_method(fn.cls, func.attr)
+            # obj.m(): only when exactly one project class defines m
+            # (and no module-level function shadows the name) — the
+            # documented unique-definer arm.
+            cands = self._methods.get(func.attr, [])
+            if len(cands) == 1:
+                return cands[0]
+            if not cands:
+                mods = self._global_funcs.get(func.attr, [])
+                if len(mods) == 1:
+                    return mods[0]
+            return None
+        if isinstance(func, ast.Name):
+            local = self._module_funcs.get(fn.ctx.path, {}).get(func.id)
+            if local is not None and local is not fn:
+                return local
+            dotted = fn.ctx.dotted(func) or func.id
+            leaf = dotted.split(".")[-1]
+            owners = self.project.classes_by_name.get(leaf, [])
+            if len(owners) == 1:
+                init = self._lookup_method(owners[0], "__init__")
+                if init is not None:
+                    return init
+            mods = self._global_funcs.get(leaf, [])
+            if len(mods) == 1 and mods[0] is not fn:
+                return mods[0]
+        return None
+
+    def callsites(self, fn: FuncNode) -> List[Tuple[FuncNode, int]]:
+        """Resolved ``(callee, lineno)`` pairs in ``fn``'s own frame
+        (nested def/lambda bodies are separate frames — skipped)."""
+        hit = self._callsites.get(fn.key)
+        if hit is not None:
+            return hit
+        out: List[Tuple[FuncNode, int]] = []
+
+        def visit(node: ast.AST) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef, ast.Lambda)):
+                    continue
+                if isinstance(child, ast.Call):
+                    callee = self.resolve_call(fn, child)
+                    if callee is not None:
+                        out.append((callee, child.lineno))
+                visit(child)
+
+        for stmt in getattr(fn.node, "body", []):
+            visit(stmt)
+        self._callsites[fn.key] = out
+        return out
+
+    def reachable(self, roots: Sequence[FuncNode]
+                  ) -> Dict[str, Tuple[FuncNode, Optional[str]]]:
+        """Multi-source BFS over call edges; ``key -> (node,
+        parent_key)`` with roots mapping to parent ``None``.  BFS order
+        makes every witness chain a shortest chain."""
+        reached: Dict[str, Tuple[FuncNode, Optional[str]]] = {}
+        frontier: List[FuncNode] = []
+        for r in roots:
+            if r.key not in reached:
+                reached[r.key] = (r, None)
+                frontier.append(r)
+        while frontier:
+            nxt: List[FuncNode] = []
+            for fn in frontier:
+                for callee, _line in self.callsites(fn):
+                    if callee.key not in reached:
+                        reached[callee.key] = (callee, fn.key)
+                        nxt.append(callee)
+            frontier = nxt
+        return reached
+
+    def witness_chain(self, reached: Dict[str, Tuple[FuncNode,
+                                                     Optional[str]]],
+                      key: str) -> List[FuncNode]:
+        """Root-to-node chain reconstructed from BFS parent pointers."""
+        chain: List[FuncNode] = []
+        cur: Optional[str] = key
+        while cur is not None:
+            fn, parent = reached[cur]
+            chain.append(fn)
+            cur = parent
+        chain.reverse()
+        return chain
+
+    # -- escapes / handlers --------------------------------------------
+    def signal_handlers(self) -> List[FuncNode]:
+        """Functions registered via ``signal.signal(sig, handler)`` —
+        they run synchronously on the main thread, so they are pump
+        roots for the blocking rule."""
+        out: List[FuncNode] = []
+        for m in self.project.modules:
+            sites = [node for node in m.walk()
+                     if isinstance(node, ast.Call)
+                     and m.dotted(node.func) == "signal.signal"
+                     and len(node.args) >= 2]
+            if not sites:
+                continue
+            encl = self._enclosing_map(m)
+            for node in sites:
+                h = node.args[1]
+                target: Optional[FuncNode] = None
+                if isinstance(h, ast.Attribute) and \
+                        isinstance(h.value, ast.Name) and \
+                        h.value.id == "self":
+                    info = encl.get(id(node))
+                    if info is not None:
+                        target = self._lookup_method(info, h.attr)
+                elif isinstance(h, ast.Name):
+                    target = self._module_funcs.get(m.path, {}).get(h.id)
+                if target is not None:
+                    out.append(target)
+        return out
+
+    def _enclosing_map(self, m: ModuleContext) -> Dict[int, ClassInfo]:
+        """node id -> enclosing ClassInfo (for the handful of whole-
+        module scans that need ``self`` resolution outside a method
+        walk)."""
+        out: Dict[int, ClassInfo] = {}
+        for info in self.project.classes:
+            if info.ctx is not m:
+                continue
+            for sub in ast.walk(info.node):
+                out[id(sub)] = info
+        return out
+
+    # -- lock context --------------------------------------------------
+    def lock_events(self, fn: FuncNode) -> Tuple[
+            List[Tuple[str, int, frozenset]],
+            List[Tuple[FuncNode, int, frozenset]]]:
+        """``(acquisitions, callsites)`` with held-lock context:
+        ``[(lock_id, line, held_before)]`` and ``[(callee, line,
+        held)]``.  A ``with`` block scopes its lock — sequential
+        ``with self._a: ... / with self._b: ...`` produces NO a->b
+        edge (released-then-reacquired is not nesting).  Nested
+        def/lambda frames are skipped (a closure runs later, on
+        whatever thread calls it)."""
+        hit = self._lock_events.get(fn.key)
+        if hit is not None:
+            return hit
+        acqs: List[Tuple[str, int, frozenset]] = []
+        calls: List[Tuple[FuncNode, int, frozenset]] = []
+        mod_locks = self.module_locks.get(fn.ctx.path, {})
+
+        def lock_id(expr: ast.AST) -> Optional[str]:
+            name = ClassInfo._self_attr(expr)
+            if name is not None and fn.cls is not None:
+                canon = fn.cls.held_lock(name)
+                if canon is not None:
+                    return f"{fn.cls.name}.{canon}"
+                return None
+            if isinstance(expr, ast.Name):
+                return mod_locks.get(expr.id)
+            return None
+
+        def visit(node: ast.AST, held: frozenset) -> None:
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                inner = held
+                for item in node.items:
+                    lid = lock_id(item.context_expr)
+                    visit(item.context_expr, inner)
+                    if lid is not None:
+                        acqs.append((lid, node.lineno, inner))
+                        inner = inner | {lid}
+                for stmt in node.body:
+                    visit(stmt, inner)
+                return
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                return
+            if isinstance(node, ast.Call):
+                callee = self.resolve_call(fn, node)
+                if callee is not None:
+                    calls.append((callee, node.lineno, held))
+            for child in ast.iter_child_nodes(node):
+                visit(child, held)
+
+        for stmt in getattr(fn.node, "body", []):
+            visit(stmt, frozenset())
+        self._lock_events[fn.key] = (acqs, calls)
+        return acqs, calls
+
+    def lock_summary(self) -> Dict[str, Dict[str, Tuple]]:
+        """Fixpoint: ``node key -> {lock_id: (line, next_key)}`` — the
+        locks a call to the node may acquire (directly or transitively)
+        with a one-step witness pointer (``next_key`` None = acquired
+        in this frame at ``line``)."""
+        if self._lock_summaries is not None:
+            return self._lock_summaries
+        summaries: Dict[str, Dict[str, Tuple]] = {}
+        for key, fn in self.nodes.items():
+            acqs, _ = self.lock_events(fn)
+            summaries[key] = {lid: (line, None) for lid, line, _h in acqs}
+        changed = True
+        while changed:
+            changed = False
+            for key, fn in self.nodes.items():
+                mine = summaries[key]
+                for callee, line, _held in self.lock_events(fn)[1]:
+                    for lid in summaries.get(callee.key, ()):
+                        if lid not in mine:
+                            mine[lid] = (line, callee.key)
+                            changed = True
+        self._lock_summaries = summaries
+        return summaries
+
+    def lock_acquisition_chain(self, key: str, lock_id: str
+                               ) -> List[Tuple[FuncNode, int]]:
+        """Expand a summary witness pointer into the concrete
+        ``[(frame, line)]`` chain ending at the frame that acquires
+        ``lock_id`` directly."""
+        summaries = self.lock_summary()
+        chain: List[Tuple[FuncNode, int]] = []
+        cur: Optional[str] = key
+        seen: Set[str] = set()
+        while cur is not None and cur not in seen:
+            seen.add(cur)
+            line, nxt = summaries[cur][lock_id]
+            chain.append((self.nodes[cur], line))
+            cur = nxt
+        return chain
+
+
+def get_callgraph(project: ProjectContext) -> CallGraph:
+    """One graph per ProjectContext — all four phase-3 rules share it."""
+    graph = getattr(project, "_phase3_callgraph", None)
+    if graph is None:
+        graph = CallGraph(project)
+        project._phase3_callgraph = graph  # type: ignore[attr-defined]
+    return graph
+
+
+# ---------------------------------------------------------------------------
+# project rule: lock-order
+# ---------------------------------------------------------------------------
+
+
+def _fmt_site(fn: FuncNode, line: int) -> str:
+    return f"{fn.ctx.path}:{line}"
+
+
+@project_rule(
+    "lock-order",
+    "cycle in the global lock-acquisition graph — two call chains "
+    "acquire the same locks in opposite orders, a deadlock candidate; "
+    "the finding names the full lock chain and per-edge witness path")
+def _check_lock_order(project: ProjectContext):
+    graph = get_callgraph(project)
+    summaries = graph.lock_summary()
+    # edges[h][l2] = (fn, line, callee_key or None): first witness wins,
+    # deterministic because node iteration follows module/class order.
+    edges: Dict[str, Dict[str, Tuple]] = {}
+
+    def add_edge(held: Iterable[str], lock: str, fn: FuncNode,
+                 line: int, callee_key: Optional[str]) -> None:
+        for h in held:
+            if h == lock:
+                continue  # same-lock re-entry is lock-discipline/RLock
+                # territory, not an ordering inversion
+            edges.setdefault(h, {}).setdefault(
+                lock, (fn, line, callee_key))
+
+    for key in sorted(graph.nodes):
+        fn = graph.nodes[key]
+        acqs, calls = graph.lock_events(fn)
+        for lid, line, held in acqs:
+            if held:
+                add_edge(held, lid, fn, line, None)
+        for callee, line, held in calls:
+            if not held:
+                continue
+            for lid in sorted(summaries.get(callee.key, ())):
+                add_edge(held, lid, fn, line, callee.key)
+
+    # cycle detection: DFS with an explicit stack-path; each cycle is
+    # reported once, keyed by its canonical (sorted) lock set.
+    reported: Set[frozenset] = set()
+    findings: List[Finding] = []
+
+    def describe_edge(a: str, b: str) -> str:
+        fn, line, callee_key = edges[a][b]
+        if callee_key is None:
+            return (f"{fn.qual} holds {a} and acquires {b} "
+                    f"({_fmt_site(fn, line)})")
+        chain = graph.lock_acquisition_chain(callee_key, b)
+        hops = " -> ".join(f.qual for f, _ in chain)
+        acq_fn, acq_line = chain[-1]
+        return (f"{fn.qual} holds {a} and calls {hops} "
+                f"({_fmt_site(fn, line)}) which acquires {b} "
+                f"({_fmt_site(acq_fn, acq_line)})")
+
+    def dfs(start: str, cur: str, path: List[str]) -> None:
+        for nxt in sorted(edges.get(cur, ())):
+            if nxt == start and len(path) >= 2:
+                locks = frozenset(path)
+                if locks in reported:
+                    continue
+                reported.add(locks)
+                cycle = path + [start]
+                chain = " -> ".join(cycle)
+                detail = "; ".join(
+                    describe_edge(cycle[i], cycle[i + 1])
+                    for i in range(len(cycle) - 1))
+                fn, line, _ = edges[cycle[0]][cycle[1]]
+                findings.append(Finding(
+                    "lock-order", fn.ctx.path, line,
+                    f"lock acquisition cycle {chain}: {detail}",
+                    hint="break the cycle by ordering the locks "
+                         "(always acquire them in one global order) or "
+                         "by dropping the outer lock before the call "
+                         "that re-enters the other subsystem"))
+            elif nxt not in path and nxt > start:
+                # only walk locks > start: each cycle is discovered
+                # exactly once, from its smallest lock
+                dfs(start, nxt, path + [nxt])
+
+    for start in sorted(edges):
+        dfs(start, start, [start])
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# project rule: blocking-in-pump
+# ---------------------------------------------------------------------------
+
+_PUMP_METHOD_NAMES = {"step", "tick", "maybe_tick", "pump"}
+_PUMP_PATH_SEGMENTS = {"orchestration", "rollout"}
+
+
+def _blocking_kind(ctx: ModuleContext, call: ast.Call) -> Optional[str]:
+    """Name the blocking primitive, or None.  Bounded waits —
+    ``join(timeout=...)``, ``wait(0.1)``, ``get(timeout=...)``,
+    ``get_nowait()`` — are deliberate and pass; ``time.sleep`` and
+    ``.recv*`` block regardless of arguments."""
+    if ctx.dotted(call.func) == "time.sleep":
+        return "time.sleep()"
+    if not isinstance(call.func, ast.Attribute):
+        return None
+    attr = call.func.attr
+    if attr in ("recv", "recv_bytes"):
+        return f".{attr}() blocking receive"
+    if attr in ("join", "wait", "get") and not call.args \
+            and not call.keywords:
+        what = {"join": ".join() without timeout",
+                "wait": ".wait() without timeout",
+                "get": ".get() without timeout (Queue.get)"}
+        return what[attr]
+    return None
+
+
+@project_rule(
+    "blocking-in-pump",
+    "blocking primitive (sleep/recv/unbounded join/wait/Queue.get) "
+    "reachable from a single-threaded pump root — a step()/tick()/"
+    "pump() method of an orchestration/rollout class, or a signal "
+    "handler; the finding names the root and the full call chain")
+def _check_blocking_in_pump(project: ProjectContext):
+    graph = get_callgraph(project)
+    roots: List[FuncNode] = []
+    for info in project.classes:
+        parts = _path_parts(info.ctx.path)
+        if is_test_path(info.ctx.path) or \
+                not _PUMP_PATH_SEGMENTS.intersection(parts[:-1]):
+            continue
+        for name in info.methods:
+            if name in _PUMP_METHOD_NAMES:
+                fn = graph.nodes.get(
+                    f"{info.ctx.path}::{info.name}.{name}")
+                if fn is not None:
+                    roots.append(fn)
+    roots.extend(h for h in graph.signal_handlers()
+                 if not is_test_path(h.ctx.path))
+    if not roots:
+        return []
+    reached = graph.reachable(roots)
+    findings: List[Finding] = []
+    for key in sorted(reached):
+        fn, _parent = reached[key]
+
+        def visit(node: ast.AST) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef, ast.Lambda)):
+                    continue
+                if isinstance(child, ast.Call):
+                    kind = _blocking_kind(fn.ctx, child)
+                    if kind is not None:
+                        chain = graph.witness_chain(reached, key)
+                        hops = " -> ".join(f.qual for f in chain)
+                        findings.append(Finding(
+                            "blocking-in-pump", fn.ctx.path, child.lineno,
+                            f"{kind} reachable from pump root "
+                            f"{chain[0].qual}; call chain: {hops}",
+                            hint="pumps own the engines single-threaded "
+                                 "— never block: use get_nowait()/"
+                                 "bounded timeouts, or move the wait to "
+                                 "a supervised worker thread"))
+                visit(child)
+
+        for stmt in getattr(fn.node, "body", []):
+            visit(stmt)
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# project rule: telemetry-drift
+# ---------------------------------------------------------------------------
+
+#: Functions whose bodies define the produced string-key universe.
+_PRODUCER_FNS = {"server_stats", "summary", "histograms", "counters",
+                 "stats"}
+#: MetricsWriter/Histogram.summary expansion columns.
+_HIST_SUFFIXES = ("_p50", "_p95", "_p99", "_mean", "_count")
+
+
+def _fstring_pattern(node: ast.JoinedStr) -> Optional[Tuple[str, str]]:
+    """``f"tenant_{t}_{m}"`` -> ("tenant_", ""); None when the literal
+    parts constrain nothing (leading AND trailing interpolation)."""
+    vals = node.values
+    prefix = vals[0].value if vals and isinstance(vals[0], ast.Constant) \
+        and isinstance(vals[0].value, str) else ""
+    suffix = vals[-1].value if len(vals) > 1 and \
+        isinstance(vals[-1], ast.Constant) and \
+        isinstance(vals[-1].value, str) else ""
+    if not prefix and not suffix:
+        return None
+    return prefix, suffix
+
+
+def _matches(key: str, patterns: Iterable[Tuple[str, str]]) -> bool:
+    return any(key.startswith(p) and key.endswith(s)
+               and len(key) >= len(p) + len(s) for p, s in patterns)
+
+
+class _TelemetryUniverse:
+    """Produced vs consumed string-key universes over one project."""
+
+    def __init__(self, project: ProjectContext):
+        graph = get_callgraph(project)
+        #: key -> (path, line) of the first production site
+        self.produced: Dict[str, Tuple[str, int]] = {}
+        #: counter-surface subset of ``produced`` (direction-b scope)
+        self.produced_counters: Dict[str, Tuple[str, int]] = {}
+        self.produced_patterns: List[Tuple[str, str, str, int]] = []
+        self.consumed: Dict[str, Tuple[str, int]] = {}
+        self.consumed_patterns: List[Tuple[Optional[str], Optional[str],
+                                           str, int]] = []
+        #: every string literal per module (documentation evidence)
+        self.mentions: Dict[str, Set[str]] = {}
+        self.has_producers = False
+        producer_nodes = [
+            fn for fn in graph.nodes.values()
+            if fn.name in _PRODUCER_FNS and _is_library(fn.ctx.path)]
+        # one level of same-frame helper expansion: ``def stats():
+        # return _sched_stats(self)`` produces _sched_stats's keys
+        expanded: List[FuncNode] = list(producer_nodes)
+        for fn in producer_nodes:
+            for callee, _line in graph.callsites(fn):
+                if callee.ctx.path == fn.ctx.path and \
+                        callee not in expanded:
+                    expanded.append(callee)
+        for fn in expanded:
+            self.has_producers = True
+            self._collect_produced(fn)
+        for m in project.modules:
+            lits: Set[str] = set()
+            for node in m.walk():
+                if isinstance(node, ast.Constant) and \
+                        isinstance(node.value, str):
+                    lits.add(node.value)
+            self.mentions[m.path] = lits
+            self._collect_consumed(m)
+
+    # -- producers -----------------------------------------------------
+    def _produce(self, fn: FuncNode, key: str, line: int) -> None:
+        self.produced.setdefault(key, (fn.ctx.path, line))
+        if fn.name in ("server_stats", "counters", "stats"):
+            self.produced_counters.setdefault(key, (fn.ctx.path, line))
+        if fn.name == "histograms":
+            for sfx in _HIST_SUFFIXES:
+                self.produced.setdefault(key + sfx, (fn.ctx.path, line))
+
+    def _produce_pattern(self, fn: FuncNode, pat: Tuple[str, str],
+                         line: int) -> None:
+        self.produced_patterns.append(
+            (pat[0], pat[1], fn.ctx.path, line))
+        if fn.name == "histograms":
+            for sfx in _HIST_SUFFIXES:
+                self.produced_patterns.append(
+                    (pat[0], pat[1] + sfx, fn.ctx.path, line))
+
+    def _seed_attr_keys(self, fn: FuncNode, attr: str) -> None:
+        """``{k: f(v) for k, v in self.X.items()}`` inside a producer:
+        the keys are whatever dict literals the class assigns to
+        ``self.X`` (the ``counters_`` seed-dict idiom)."""
+        if fn.cls is None:
+            return
+        for sub in ast.walk(fn.cls.node):
+            targets, value = _assign_targets_value(sub)
+            if not isinstance(value, ast.Dict):
+                continue
+            for t in targets:
+                if ClassInfo._self_attr(t) == attr:
+                    for k in value.keys:
+                        if isinstance(k, ast.Constant) and \
+                                isinstance(k.value, str):
+                            self._produce(fn, k.value, sub.lineno)
+
+    def _collect_produced(self, fn: FuncNode) -> None:
+        for node in ast.walk(fn.node):
+            if isinstance(node, ast.Dict):
+                for k in node.keys:
+                    if isinstance(k, ast.Constant) and \
+                            isinstance(k.value, str):
+                        self._produce(fn, k.value, k.lineno)
+                    elif isinstance(k, ast.JoinedStr):
+                        pat = _fstring_pattern(k)
+                        if pat:
+                            self._produce_pattern(fn, pat, k.lineno)
+            elif isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if not isinstance(t, ast.Subscript):
+                        continue
+                    sl = t.slice
+                    if isinstance(sl, ast.Constant) and \
+                            isinstance(sl.value, str):
+                        self._produce(fn, sl.value, t.lineno)
+                    elif isinstance(sl, ast.JoinedStr):
+                        pat = _fstring_pattern(sl)
+                        if pat:
+                            self._produce_pattern(fn, pat, t.lineno)
+            elif isinstance(node, ast.DictComp):
+                gen = node.generators[0]
+                if isinstance(gen.iter, (ast.Tuple, ast.List, ast.Set)):
+                    for el in gen.iter.elts:
+                        if isinstance(el, ast.Constant) and \
+                                isinstance(el.value, str):
+                            self._produce(fn, el.value, el.lineno)
+                elif isinstance(gen.iter, ast.Call) and \
+                        isinstance(gen.iter.func, ast.Attribute) and \
+                        gen.iter.func.attr == "items":
+                    attr = ClassInfo._self_attr(gen.iter.func.value)
+                    if attr is not None:
+                        self._seed_attr_keys(fn, attr)
+                if isinstance(node.key, ast.JoinedStr):
+                    pat = _fstring_pattern(node.key)
+                    if pat:
+                        self._produce_pattern(fn, pat, node.key.lineno)
+
+    # -- consumers -----------------------------------------------------
+    @staticmethod
+    def _is_producer_call(node: ast.AST) -> bool:
+        return isinstance(node, ast.Call) and \
+            isinstance(node.func, ast.Attribute) and \
+            node.func.attr in _PRODUCER_FNS
+
+    @staticmethod
+    def _scope_nodes(scope: ast.AST) -> List[ast.AST]:
+        """The nodes of one frame only — nested def/lambda bodies
+        belong to their own scope (each scope is analyzed exactly
+        once; a module-level walk must not re-read function bodies)."""
+        out: List[ast.AST] = []
+
+        def visit(node: ast.AST) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef, ast.Lambda)):
+                    continue
+                out.append(child)
+                visit(child)
+
+        visit(scope)
+        return out
+
+    def _collect_consumed(self, m: ModuleContext) -> None:
+        scopes: List[ast.AST] = [m.tree]
+        for node in m.walk():
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                scopes.append(node)
+        for scope in scopes:
+            name = getattr(scope, "name", None)
+            if name in _PRODUCER_FNS and _is_library(m.path):
+                continue  # a producer's own body is not consumption
+            nodes = self._scope_nodes(scope)
+            stats_vars: Set[str] = set()
+            calls_producer = False
+            for node in nodes:
+                if isinstance(node, ast.Assign) and \
+                        self._is_producer_call(node.value):
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            stats_vars.add(t.id)
+                if self._is_producer_call(node):
+                    calls_producer = True
+            for node in nodes:
+                self._consume_from(m, node, stats_vars, calls_producer)
+
+    def _consume_from(self, m: ModuleContext, node: ast.AST,
+                      stats_vars: Set[str], calls_producer: bool) -> None:
+        def stats_expr(e: ast.AST) -> bool:
+            if isinstance(e, ast.Name) and e.id in stats_vars:
+                return True
+            return isinstance(e, ast.Call) and \
+                isinstance(e.func, ast.Attribute) and \
+                e.func.attr in _PRODUCER_FNS
+
+        if isinstance(node, ast.Subscript) and stats_expr(node.value) \
+                and isinstance(getattr(node, "ctx", None), ast.Load):
+            sl = node.slice
+            if isinstance(sl, ast.Constant) and \
+                    isinstance(sl.value, str):
+                self.consumed.setdefault(
+                    sl.value, (m.path, node.lineno))
+        elif isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute):
+            attr = node.func.attr
+            if attr == "get" and stats_expr(node.func.value) and \
+                    node.args and isinstance(node.args[0], ast.Constant) \
+                    and isinstance(node.args[0].value, str):
+                self.consumed.setdefault(
+                    node.args[0].value, (m.path, node.lineno))
+            elif calls_producer and attr in ("startswith", "endswith") \
+                    and len(node.args) == 1 and \
+                    isinstance(node.args[0], ast.Constant) and \
+                    isinstance(node.args[0].value, str):
+                lit = node.args[0].value
+                pat = (lit, None) if attr == "startswith" else (None, lit)
+                self.consumed_patterns.append(
+                    (pat[0], pat[1], m.path, node.lineno))
+        elif isinstance(node, ast.Compare) and \
+                len(node.ops) == 1 and \
+                isinstance(node.ops[0], (ast.In, ast.NotIn)) and \
+                isinstance(node.left, ast.Constant) and \
+                isinstance(node.left.value, str) and \
+                stats_expr(node.comparators[0]):
+            self.consumed.setdefault(
+                node.left.value, (m.path, node.lineno))
+
+    # -- matching ------------------------------------------------------
+    def key_is_produced(self, key: str) -> bool:
+        if key in self.produced:
+            return True
+        return _matches(key, [(p, s) for p, s, _pp, _l
+                              in self.produced_patterns])
+
+    def consumed_pattern_is_produced(self, prefix: Optional[str],
+                                     suffix: Optional[str]) -> bool:
+        for key in self.produced:
+            if (prefix is None or key.startswith(prefix)) and \
+                    (suffix is None or key.endswith(suffix)):
+                return True
+        for p, s, _pp, _l in self.produced_patterns:
+            ok_p = prefix is None or p.startswith(prefix) or \
+                prefix.startswith(p)
+            ok_s = suffix is None or s.endswith(suffix) or \
+                suffix.endswith(s)
+            if ok_p and ok_s:
+                return True
+        return False
+
+    def key_is_consumed(self, key: str, produced_path: str) -> bool:
+        if key in self.consumed:
+            return True
+        for p, s, _pp, _l in self.consumed_patterns:
+            if (p is None or key.startswith(p)) and \
+                    (s is None or key.endswith(s)):
+                return True
+        for path, lits in self.mentions.items():
+            if path != produced_path and key in lits:
+                return True  # read or at least documented elsewhere
+        return False
+
+
+@project_rule(
+    "telemetry-drift",
+    "server_stats()/telemetry string-key drift: a consumed key nothing "
+    "produces, a consumed prefix pattern no producer can satisfy, or a "
+    "produced counter nothing reads or mentions anywhere else — the "
+    "static twin of the tenant-counter reset-carry bug")
+def _check_telemetry_drift(project: ProjectContext):
+    uni = _TelemetryUniverse(project)
+    if not uni.has_producers:
+        return []  # subset run without the producing modules: no basis
+    findings: List[Finding] = []
+    for key in sorted(uni.consumed):
+        path, line = uni.consumed[key]
+        if not uni.key_is_produced(key):
+            findings.append(Finding(
+                "telemetry-drift", path, line,
+                f"telemetry key {key!r} is consumed here but no "
+                "server_stats()/telemetry producer emits it",
+                hint="produce the key (or fix the spelling) — a "
+                     "consumer of a phantom key silently reads its "
+                     "default forever"))
+    for prefix, suffix, path, line in uni.consumed_patterns:
+        if not uni.consumed_pattern_is_produced(prefix, suffix):
+            pat = f"{prefix or '*'}...{suffix or '*'}"
+            findings.append(Finding(
+                "telemetry-drift", path, line,
+                f"telemetry key pattern {pat!r} is consumed here but "
+                "no producer emits a matching key",
+                hint="no produced key or f-string key family matches "
+                     "this startswith/endswith filter — it can never "
+                     "select anything"))
+    for key in sorted(uni.produced_counters):
+        path, line = uni.produced_counters[key]
+        if not uni.key_is_consumed(key, path):
+            findings.append(Finding(
+                "telemetry-drift", path, line,
+                f"telemetry counter {key!r} is produced here but "
+                "nothing reads or mentions it anywhere else",
+                hint="wire a reader (SignalReader/bench/test) or drop "
+                     "the counter — unread telemetry is drift waiting "
+                     "to be trusted"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# project rule: fault-coverage
+# ---------------------------------------------------------------------------
+
+
+@project_rule(
+    "fault-coverage",
+    "FAULT_POINTS registry coverage: every registered fault point must "
+    "be fired by a fault_point(...) call site in library code AND "
+    "exercised by a test/bench plan spec; a fault_point literal "
+    "outside the registry is a typo")
+def _check_fault_coverage(project: ProjectContext):
+    registry: Dict[str, Tuple[str, int]] = {}
+    registry_paths: Set[str] = set()
+    fired: Dict[str, Tuple[str, int]] = {}
+    typos: List[Tuple[str, str, int]] = []
+    exercised: Set[str] = set()
+    for m in project.modules:
+        for node in m.walk():
+            targets, value = _assign_targets_value(node)
+            names = {t.id for t in targets if isinstance(t, ast.Name)}
+            if "FAULT_POINTS" in names and value is not None:
+                elts = []
+                if isinstance(value, ast.Call) and value.args and \
+                        isinstance(value.args[0], (ast.Set, ast.Tuple,
+                                                   ast.List)):
+                    elts = value.args[0].elts
+                elif isinstance(value, (ast.Set, ast.Tuple, ast.List)):
+                    elts = value.elts
+                for el in elts:
+                    if isinstance(el, ast.Constant) and \
+                            isinstance(el.value, str):
+                        registry.setdefault(
+                            el.value, (m.path, el.lineno))
+                        registry_paths.add(m.path)
+            if isinstance(node, ast.Call):
+                d = m.dotted(node.func) or ""
+                if (d == "fault_point" or d.endswith(".fault_point")) \
+                        and node.args and \
+                        isinstance(node.args[0], ast.Constant) and \
+                        isinstance(node.args[0].value, str):
+                    name = node.args[0].value
+                    if _is_library(m.path):
+                        fired.setdefault(name, (m.path, node.lineno))
+                    else:
+                        exercised.add(name)
+                    typos.append((name, m.path, node.lineno))
+    if not registry:
+        return []  # subset run without the registry module
+    # plan-spec evidence: string literals in test/bench modules that
+    # name the point — FaultPlan({"point": ...}) keys and
+    # "point:at=4+5" spec strings both contain the name; module/class/
+    # function docstrings are prose, not evidence.
+    for m in project.modules:
+        if not (is_test_path(m.path) or _is_bench_or_script(m.path)):
+            continue
+        docstrings = _docstring_ids(m.tree)
+        for node in m.walk():
+            if not (isinstance(node, ast.Constant) and
+                    isinstance(node.value, str)) or \
+                    id(node) in docstrings:
+                continue
+            for name in registry:
+                if name in node.value:
+                    exercised.add(name)
+    findings: List[Finding] = []
+    for name, path, line in typos:
+        if name not in registry and path not in registry_paths:
+            findings.append(Finding(
+                "fault-coverage", path, line,
+                f"fault_point({name!r}) is not in the FAULT_POINTS "
+                "registry — this call raises at runtime",
+                hint="register the point or fix the literal (the "
+                     "registry rejects unknown names by design)"))
+    for name in sorted(registry):
+        path, line = registry[name]
+        if name not in fired:
+            findings.append(Finding(
+                "fault-coverage", path, line,
+                f"fault point {name!r} is registered but no library "
+                "fault_point(...) call site fires it",
+                hint="add the injection site or drop the registration "
+                     "— a dead registry entry advertises chaos "
+                     "coverage that does not exist"))
+        elif name not in exercised:
+            fp, fl = fired[name]
+            findings.append(Finding(
+                "fault-coverage", path, line,
+                f"fault point {name!r} is fired at {fp}:{fl} but no "
+                "test/bench plan spec exercises it",
+                hint="add a FaultPlan({'" + name + "': ...}) test or a "
+                     "bench spec — an unexercised fault point is "
+                     "untested chaos surface"))
+    return findings
+
+
+def _docstring_ids(tree: ast.Module) -> Set[int]:
+    """ids of Constant nodes that are module/class/function docstrings."""
+    out: Set[int] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.Module, ast.ClassDef, ast.FunctionDef,
+                             ast.AsyncFunctionDef)):
+            body = getattr(node, "body", [])
+            if body and isinstance(body[0], ast.Expr) and \
+                    isinstance(body[0].value, ast.Constant) and \
+                    isinstance(body[0].value.value, str):
+                out.add(id(body[0].value))
+    return out

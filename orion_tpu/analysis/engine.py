@@ -281,6 +281,14 @@ def _finalize(findings: List[Finding],
 # ---------------------------------------------------------------------------
 
 
+#: Size cap for the on-disk cache file: past this, whole non-active
+#: sections are evicted least-recently-SAVED-first (the active
+#: section — the run that is saving — is never evicted, so a subset
+#: ``--rule`` run can age out stale fingerprints but can never wipe
+#: the full-tree section it is currently serving).
+CACHE_MAX_BYTES = 4_000_000
+
+
 class ResultCache:
     """Per-file rule results keyed by content hash.
 
@@ -295,9 +303,11 @@ class ResultCache:
     package itself (or the active rule set) changes — a rule edit must
     re-lint the world."""
 
-    def __init__(self, path: str, fingerprint: str):
+    def __init__(self, path: str, fingerprint: str,
+                 max_bytes: int = CACHE_MAX_BYTES):
         self.path = path
         self.fingerprint = fingerprint
+        self.max_bytes = max_bytes
         self.hits = 0
         self.misses = 0
         # One file holds a SECTION per rule-set fingerprint (bounded),
@@ -382,6 +392,16 @@ class ResultCache:
         self._sections[self.fingerprint] = self._files
         while len(self._sections) > 4:
             self._sections.pop(next(iter(self._sections)))
+        # size cap: sections accumulate across --rule subsets; evict
+        # whole sections LRU (insertion order = save recency, active
+        # last) until the serialized payload fits.  The ACTIVE section
+        # survives even when it alone exceeds the cap — a size limit
+        # must never wipe the run that is saving (the full-tree gate's
+        # own entries in particular).
+        while len(self._sections) > 1 and \
+                len(json.dumps({"sections": self._sections})) > \
+                self.max_bytes:
+            self._sections.pop(next(iter(self._sections)))
         try:
             os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
             with open(tmp, "w", encoding="utf-8") as fh:
@@ -439,9 +459,19 @@ def _parse(source: str, path: str):
 def _analyze_modules(sources: List[Tuple[str, str]],
                      rules: Optional[Sequence],
                      keep_suppressed: bool = False,
-                     cache: Optional[ResultCache] = None
+                     cache: Optional[ResultCache] = None,
+                     file_phase_paths: Optional[Set[str]] = None,
+                     stats: Optional[dict] = None
                      ) -> List[Finding]:
-    """The full two-phase pipeline over (path, source) pairs."""
+    """The full two-phase pipeline over (path, source) pairs.
+
+    ``file_phase_paths`` (the ``--changed`` mode) restricts the
+    per-file phase — and the unused-suppression sweep, which can only
+    judge files whose per-file rules ran — to the named paths; every
+    file is still parsed and the project phase always sees the full
+    set, so project-rule findings are identical to a full run.
+    ``stats``, when given, is filled with run counters for the
+    ``--stats`` line."""
     file_rules, project_rules, run_unused, report_ids = \
         _split_rules(rules)
 
@@ -455,6 +485,8 @@ def _analyze_modules(sources: List[Tuple[str, str]],
             continue
         contexts[path] = ctx
         ordered_ctx.append(ctx)
+        if file_phase_paths is not None and path not in file_phase_paths:
+            continue
         per_file: Optional[List[Finding]] = None
         sha1 = None
         if cache is not None:
@@ -478,10 +510,23 @@ def _analyze_modules(sources: List[Tuple[str, str]],
             fired.setdefault(f.path, {}).setdefault(
                 f.line, set()).add(f.rule_id)
         for ctx in ordered_ctx:
+            if file_phase_paths is not None and \
+                    ctx.path not in file_phase_paths:
+                continue
             raw.extend(_unused_suppressions(
                 ctx, fired.get(ctx.path, {})))
 
-    return _finalize(raw, contexts, keep_suppressed, report_ids)
+    out = _finalize(raw, contexts, keep_suppressed, report_ids)
+    if stats is not None:
+        stats.update({
+            "files": len(sources),
+            "rules": len(file_rules) + len(project_rules),
+            "findings": len(out),
+            "cache_hits": cache.hits if cache is not None else 0,
+            "cache_lookups": (cache.hits + cache.misses)
+            if cache is not None else 0,
+        })
+    return out
 
 
 def analyze_source(source: str, path: str = "<string>",
@@ -506,6 +551,81 @@ def analyze_file(path: str, rules: Optional[Sequence] = None) -> \
         List[Finding]:
     with open(path, "r", encoding="utf-8") as fh:
         return analyze_source(fh.read(), path, rules=rules)
+
+
+_STALE_RID_RE = re.compile(r"suppression for '([^']+)'")
+
+
+def fix_suppressions(paths: Sequence[str]) -> List[Tuple[str, int]]:
+    """Autofix for ``unused-suppression``: delete stale ``# orion:
+    ignore[...]`` comments in place and return the edited ``(path,
+    line)`` pairs.
+
+    Pure comment-token surgery — the line's code is byte-identical,
+    only the comment token is rewritten (stale rule ids dropped from
+    the bracket list) or removed (every id stale, or a stale
+    bracketless ignore); a line that was nothing but the stale comment
+    is deleted.  The AST is never re-emitted, so formatting, quotes
+    and neighboring lines cannot churn."""
+    findings = analyze_paths(paths)
+    stale: Dict[str, Dict[int, Optional[Set[str]]]] = {}
+    for f in findings:
+        if f.rule_id != "unused-suppression":
+            continue
+        per = stale.setdefault(f.path, {})
+        m = _STALE_RID_RE.search(f.message)
+        if m is None:
+            per[f.line] = None  # bracketless: the whole comment goes
+        elif per.get(f.line, set()) is not None:
+            per.setdefault(f.line, set()).add(m.group(1))
+    edits: List[Tuple[str, int]] = []
+    for path in sorted(stale):
+        with open(path, "r", encoding="utf-8") as fh:
+            src = fh.read()
+        lines = src.splitlines(True)
+        comments: Dict[int, Tuple[int, str]] = {}
+        try:
+            for tok in tokenize.generate_tokens(
+                    io.StringIO(src).readline):
+                if tok.type == tokenize.COMMENT:
+                    comments[tok.start[0]] = (tok.start[1], tok.string)
+        except (tokenize.TokenError, IndentationError, SyntaxError):
+            continue
+        drop: Set[int] = set()
+        touched = False
+        for line, stale_ids in sorted(stale[path].items()):
+            hit = comments.get(line)
+            if hit is None or line > len(lines):
+                continue
+            col, text = hit
+            m = _SUPPRESS_RE.search(text)
+            if m is None:
+                continue
+            ids = m.group("ids")
+            keep: List[str] = []
+            if ids is not None and stale_ids is not None:
+                keep = [s.strip() for s in ids.split(",")
+                        if s.strip() and s.strip() not in stale_ids]
+            raw = lines[line - 1]
+            body = raw.rstrip("\r\n")
+            ending = raw[len(body):]
+            if keep:
+                s, e = m.span("ids")
+                new_text = text[:s] + ", ".join(keep) + text[e:]
+                lines[line - 1] = body[:col] + new_text + ending
+            else:
+                prefix = body[:col].rstrip()
+                if prefix:
+                    lines[line - 1] = prefix + ending
+                else:
+                    drop.add(line)
+            touched = True
+            edits.append((path, line))
+        if touched:
+            out = [ln for i, ln in enumerate(lines, 1) if i not in drop]
+            with open(path, "w", encoding="utf-8") as fh:
+                fh.write("".join(out))
+    return edits
 
 
 def iter_python_files(paths: Sequence[str]) -> Iterator[str]:
@@ -543,10 +663,14 @@ def iter_python_files(paths: Sequence[str]) -> Iterator[str]:
 
 def analyze_paths(paths: Sequence[str],
                   rules: Optional[Sequence] = None,
-                  cache_path: Optional[str] = None) -> List[Finding]:
+                  cache_path: Optional[str] = None,
+                  file_phase_paths: Optional[Sequence[str]] = None,
+                  stats: Optional[dict] = None) -> List[Finding]:
     """Analyze files/directories; both phases.  ``cache_path`` enables
     the per-file result cache (the CLI's default; library callers and
-    the test fixtures skip it)."""
+    the test fixtures skip it).  ``file_phase_paths`` restricts the
+    per-file phase to those paths (``--changed``); the project phase
+    always runs over everything named by ``paths``."""
     cache = None
     if cache_path:
         cache = ResultCache(cache_path, ruleset_fingerprint(rules))
@@ -554,7 +678,14 @@ def analyze_paths(paths: Sequence[str],
     for fp in iter_python_files(paths):
         with open(fp, "r", encoding="utf-8") as fh:
             sources.append((fp, fh.read()))
-    findings = _analyze_modules(sources, rules, cache=cache)
+    changed: Optional[Set[str]] = None
+    if file_phase_paths is not None:
+        # normalize both sides so `a/b.py` from git matches `./a/b.py`
+        norm = {os.path.normpath(p) for p in file_phase_paths}
+        changed = {p for p, _ in sources
+                   if os.path.normpath(p) in norm}
+    findings = _analyze_modules(sources, rules, cache=cache,
+                                file_phase_paths=changed, stats=stats)
     if cache is not None:
         cache.prune([p for p, _ in sources])
         cache.save()

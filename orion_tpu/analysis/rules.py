@@ -982,8 +982,11 @@ RULES.append(Rule(
     _unused_suppression_stub))
 
 
-# Project rules (analysis/project.py) share this registry so the CLI
-# lists one table; the engine dispatches them by Rule.kind.
+# Project rules (analysis/project.py phase 2, analysis/callgraph.py
+# phase 3 — importing callgraph registers its rules into PROJECT_RULES)
+# share this registry so the CLI lists one table; the engine dispatches
+# them by Rule.kind.
+from orion_tpu.analysis import callgraph  # noqa: E402,F401
 from orion_tpu.analysis.project import PROJECT_RULES  # noqa: E402
 
 RULES.extend(PROJECT_RULES)

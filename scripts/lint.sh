@@ -15,6 +15,17 @@
 #   bash scripts/lint.sh --format sarif        # CI-ingestible output
 #   bash scripts/lint.sh --baseline b.json     # warn-first landing
 #   bash scripts/lint.sh --no-cache            # bypass the result cache
+#   bash scripts/lint.sh --changed             # per-file phase only on
+#                                              # files changed vs
+#                                              # `git merge-base HEAD
+#                                              # main` (project phase
+#                                              # still full-tree)
+#   bash scripts/lint.sh --stats               # one-line perf summary
+#                                              # (rules/findings/cache
+#                                              # hit rate/wall) on
+#                                              # stderr
+#   bash scripts/lint.sh --fix-suppressions    # delete stale
+#                                              # `# orion: ignore` comments
 #
 # Flags (anything starting with "-") pass straight through to
 # `python -m orion_tpu.analysis`; positional args REPLACE the default

@@ -416,9 +416,14 @@ def test_continuous_engine_request_telemetry():
     stats = eng.server_stats()
     for key in ("queue_wait_s_p95", "ttft_s_p99", "tok_per_s_p50",
                 "page_occupancy_mean", "requests_finished",
-                "preempted_requests", "prefix_cached_pages"):
+                "requests_preempted", "preempted_requests",
+                "prefix_cached_pages", "page_pool_size",
+                "cancelled_requests", "spec_accept_ema"):
         assert key in stats, key
     assert stats["requests_finished"] == 6.0
+    assert stats["page_pool_size"] == float(eng.num_pages)
+    assert stats["cancelled_requests"] == 0.0
+    assert stats["spec_accept_ema"] == 0.0   # spec decoding off here
     eng.reset_server_stats()
     assert eng.server_stats()["requests_finished"] == 0.0
     assert eng.telemetry.queue_wait_s.count == 0

@@ -322,6 +322,30 @@ def test_checkpoint_explicit_step_stays_strict(tmp_path):
                      state_template={"w": jnp.zeros(2, jnp.float32)})
 
 
+def test_checkpoint_restore_fault_falls_back_a_step(tmp_path):
+    """An injected checkpoint.restore fault on the newest step makes
+    the latest-step restore fall back to the previous step (same path
+    the corruption test exercises, but via the fault registry); an
+    explicitly requested step stays strict and re-raises."""
+    from orion_tpu.utils.checkpoint import CheckpointManager
+
+    d = str(tmp_path / "ckpt")
+    mgr = CheckpointManager(d, async_save=False)
+    mgr.save(1, {"w": jnp.ones(2, jnp.float32)})
+    mgr.save(2, {"w": jnp.ones(2, jnp.float32) * 2})
+    mgr.wait()
+    template = {"w": jnp.zeros(2, jnp.float32)}
+    with active_plan(FaultPlan({"checkpoint.restore": {"at": 1}})) as plan:
+        with pytest.warns(UserWarning, match="failed to restore"):
+            out = mgr.restore(state_template=template)
+    assert plan.events == [("checkpoint.restore", 1)]
+    np.testing.assert_allclose(np.asarray(out["state"]["w"]),
+                               np.ones(2, dtype=np.float32))
+    with active_plan(FaultPlan({"checkpoint.restore": {"at": 1}})):
+        with pytest.raises(InjectedFault):
+            mgr.restore(step=2, state_template=template)
+
+
 def test_checkpoint_save_retries_through_injected_fault(tmp_path):
     from orion_tpu.utils.checkpoint import CheckpointManager
 

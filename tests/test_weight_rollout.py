@@ -108,8 +108,12 @@ def test_clean_fleet_roll_commits(fleet, setup):
     co.begin(new, version=1)
     _run(co, fleet)
     assert co.version == 1
-    assert co.counters()["rollout_commits"] == 1
-    assert co.counters()["rollout_faults"] == 0
+    counters = co.counters()
+    assert counters["rollout_commits"] == 1
+    assert counters["rollout_faults"] == 0
+    assert counters["rollout_pushes"] == 1
+    assert counters["rollout_version"] == 1.0
+    assert counters["rollout_active"] == 0.0   # roll fully landed
     for idx, eng in enumerate(fleet):
         assert eng.params_snapshot() is new
         assert not eng.draining
@@ -153,6 +157,47 @@ def test_torn_push_rolls_back(fleet, setup):
     fleet[0].submit(9, np.arange(1, 9, dtype=np.int32), budget=4)
     while fleet[0].pending:
         fleet[0].step()
+
+
+def test_drain_fault_rolls_back(fleet, setup):
+    """engine.drain fault on the very first gate: no engine has been
+    touched yet, but the coordinator still walks the rollback ladder
+    and the fleet converges on OLD."""
+    cfg, model, params = setup
+    plan = FaultPlan({"engine.drain": {"at": 1}}, seed=0)
+    with active_plan(plan):
+        co = WeightRolloutCoordinator(engines=fleet)
+        co.begin(_perturb(params), version=1)
+        _run(co, fleet)
+    assert plan.events == [("engine.drain", 1)]
+    assert co.version == 0
+    c = co.counters()
+    assert c["rollout_rollbacks"] == 1 and c["rollout_commits"] == 0
+    assert c["rollout_faults"] == 1
+    assert c["rollout_canary_failures"] == 0
+    for eng in fleet:
+        assert eng.params_snapshot() is params
+        assert not eng.draining
+
+
+def test_canary_fault_rolls_back(fleet, setup):
+    """engine.canary fault on the first upgraded engine: it already
+    holds the NEW snapshot, so the rollback must reload OLD before
+    readmitting — the torn state never commits."""
+    cfg, model, params = setup
+    plan = FaultPlan({"engine.canary": {"at": 1}}, seed=0)
+    with active_plan(plan):
+        co = WeightRolloutCoordinator(engines=fleet)
+        co.begin(_perturb(params), version=1)
+        _run(co, fleet)
+    assert plan.events == [("engine.canary", 1)]
+    assert co.version == 0
+    c = co.counters()
+    assert c["rollout_rollbacks"] == 1 and c["rollout_commits"] == 0
+    assert c["rollout_canary_failures"] == 1
+    for eng in fleet:
+        assert eng.params_snapshot() is params
+        assert not eng.draining
 
 
 def test_engine_crash_mid_reload_rolls_back(fleet, setup, monkeypatch):

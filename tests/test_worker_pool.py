@@ -294,6 +294,50 @@ def test_generate_fn_oserror_is_a_crash_not_learner_gone():
         pool.shutdown(goodbye=False)
 
 
+def test_worker_hello_fault_is_a_crash_before_admission():
+    """An injected worker.hello fault fires before the TCP connect:
+    the client constructor raises, the pool never admits the worker,
+    and no death is recorded (there was nothing to supervise yet)."""
+    from orion_tpu.resilience import InjectedFault
+
+    pool = WorkerPool(0, heartbeat_timeout=5.0)
+    try:
+        plan = FaultPlan({"worker.hello": {"at": 1}}, seed=0)
+        with active_plan(plan):
+            w = FakeWorker(pool.port, 0, n_batches=1)
+            w.join()
+        assert plan.events == [("worker.hello", 1)]
+        assert isinstance(w.error, InjectedFault)
+        assert pool.recovery["worker_joins"] == 0
+        assert pool.recovery["worker_deaths"] == 0
+    finally:
+        pool.shutdown(goodbye=False)
+
+
+def test_worker_heartbeat_fault_drops_one_beat_not_the_worker():
+    """An injected worker.heartbeat fault skips a single beat and
+    keeps the sender thread alive: the learner merely sees a missed
+    heartbeat, the worker still delivers its batch and leaves
+    cleanly — no death, no discarded work."""
+    pool = WorkerPool(0, heartbeat_timeout=5.0)
+    try:
+        pool.broadcast({"w": np.ones(1)}, 0)
+        plan = FaultPlan({"worker.heartbeat": {"at": 1}}, seed=0)
+        with active_plan(plan):
+            w = FakeWorker(pool.port, 0, n_batches=1)
+            got = pool.next_item(timeout=20.0)
+            assert got is not None
+            w.join()
+        assert plan.events == [("worker.heartbeat", 1)]
+        assert w.error is None
+        _wait_until(lambda: pool.recovery["worker_leaves"] == 1,
+                    msg="clean leave after the dropped beat")
+        assert pool.recovery["worker_deaths"] == 0
+        assert pool.recovery["discarded_batches"] == 0
+    finally:
+        pool.shutdown()
+
+
 def test_rejoin_budget_refuses_flapping_worker():
     pool = WorkerPool(0, heartbeat_timeout=5.0, rejoin_budget=1)
     try:
