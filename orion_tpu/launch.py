@@ -274,7 +274,7 @@ def run_pool_worker(cfg, port: int, rank: int,
 def run_serve(cfg, port: int = 0, tenant_spec: Optional[str] = None,
               host: str = "localhost", stop=None,
               on_ready=None, n_engines: int = 1,
-              rollout: bool = False) -> Any:
+              rollout: bool = False, gateways: int = 1) -> Any:
     """Serving-gateway process body (PR 12): the continuous engine as
     a network service.  Builds the engine through the same machinery
     the pool workers use (:func:`build_rollout_engine`), loads weights
@@ -288,6 +288,17 @@ def run_serve(cfg, port: int = 0, tenant_spec: Optional[str] = None,
     :class:`~orion_tpu.orchestration.rollout_controller.WeightRolloutCoordinator`
     so a version-tagged param push rolls through the fleet blue/green
     with zero observed downtime (``cfg.rollout_update`` knobs).
+
+    ``--gateways N`` (PR 20) fronts the SAME engine fleet with N
+    gateway replicas sharing one
+    :class:`~orion_tpu.orchestration.replica.EdgeCoordinator`:
+    prefix-affine routing, shared admission gates, and client
+    failover across the live edge.  The primary replica pumps on this
+    thread (and owns the engines while it lives); the others run
+    background pumps and inherit ownership if it dies.  With an
+    explicit ``--port`` the replicas listen on ``port .. port+N-1``;
+    port 0 gives every replica an ephemeral port (clients learn the
+    edge set from the HELLO ack / FRAME_EDGE pushes either way).
 
     ``on_ready(gateway)`` is the in-process harness hook (the tier-1
     smoke learns the ephemeral port from it); ``stop`` is any object
@@ -325,17 +336,33 @@ def run_serve(cfg, port: int = 0, tenant_spec: Optional[str] = None,
     autopilot = None
     if cfg.controller.enabled:
         # Closed-loop SLO autopilot (PR 13): the gateway pump drives
-        # its ticks, so the one thread that owns the engine also owns
-        # every setpoint/QoS actuation.
+        # its ticks, so the one thread that owns the engines also owns
+        # every setpoint/QoS actuation.  The full fleet goes in (PR
+        # 20): signals merge, actuations fan out — and with replicas,
+        # the ONE shared instance is ticked by whichever replica owns
+        # the engines.
         from orion_tpu.orchestration.autopilot import SLOAutopilot
 
-        autopilot = SLOAutopilot(cfg.controller, engine=engine)
-    gw = ServingGateway(engines, port=port, host=host, tenants=tenants,
-                        autopilot=autopilot)
+        autopilot = SLOAutopilot(cfg.controller, engine=engines)
+    n_gateways = max(1, int(gateways))
+    edge = None
+    if n_gateways > 1:
+        from orion_tpu.orchestration.replica import EdgeCoordinator
+
+        edge = EdgeCoordinator(engines)
+    replicas = []
+    for rank in range(n_gateways):
+        rport = port + rank if port else 0
+        replicas.append(ServingGateway(
+            engines, port=rport, host=host, tenants=tenants,
+            autopilot=autopilot, edge=edge))
+    gw = replicas[0]
     if rollout:
         # Fleet weight-rollout coordinator (PR 18): ticked from the
-        # gateway pump; a learner thread stages pushes via
-        # ``gw.rollout.begin(params, version)``.
+        # engine-owning pump; a learner thread stages pushes via
+        # ``gw.rollout.begin(params, version)``.  With an edge the
+        # attach writes through to ``edge.rollout``, so the roll
+        # survives any one replica's death.
         from orion_tpu.orchestration.rollout_controller import (
             WeightRolloutCoordinator)
 
@@ -345,14 +372,21 @@ def run_serve(cfg, port: int = 0, tenant_spec: Optional[str] = None,
     if threading.current_thread() is threading.main_thread():
         handler = install_handler()
     print(f"[serve] gateway listening on {host}:{gw.port} "
-          f"(engines={len(engines)}, slots={engine.slots}, "
-          f"pages={engine.num_pages}, rollout={'on' if rollout else 'off'})",
+          f"(engines={len(engines)}, gateways={n_gateways}, "
+          f"slots={engine.slots}, pages={engine.num_pages}, "
+          f"rollout={'on' if rollout else 'off'})",
           flush=True)
     if on_ready is not None:
         on_ready(gw)
     try:
+        for rep in replicas[1:]:
+            rep.start()
         gw.serve_forever(stop=stop, preemption=handler)
     finally:
+        # Secondaries first: each leaves the edge gracefully and
+        # forwards leftover engine work to the (still live) owner.
+        for rep in reversed(replicas[1:]):
+            rep.close()
         gw.close()
     return gw.stats
 
@@ -481,6 +515,7 @@ def main(argv: Optional[list] = None) -> Any:
         yaml_path = argv[i + 1]
         del argv[i:i + 2]
     serve_port, tenant_spec, n_engines, rollout = 0, None, 1, False
+    n_gateways = 1
     if algo == "serve":
         if "--port" in argv:
             i = argv.index("--port")
@@ -494,6 +529,10 @@ def main(argv: Optional[list] = None) -> Any:
             i = argv.index("--engines")
             n_engines = int(argv[i + 1])
             del argv[i:i + 2]
+        if "--gateways" in argv:
+            i = argv.index("--gateways")
+            n_gateways = int(argv[i + 1])
+            del argv[i:i + 2]
         if "--rollout" in argv:
             argv.remove("--rollout")
             rollout = True
@@ -506,7 +545,8 @@ def main(argv: Optional[list] = None) -> Any:
         return run_serve(cfg, port=serve_port, tenant_spec=tenant_spec,
                          host=os.environ.get("ORION_SERVE_HOST",
                                              "localhost"),
-                         n_engines=n_engines, rollout=rollout)
+                         n_engines=n_engines, rollout=rollout,
+                         gateways=n_gateways)
 
     # Rollout-worker process (spawned by the pool branch below): the
     # env routing keeps the CLI surface unchanged — a worker re-parses

@@ -246,6 +246,10 @@ class AsyncOrchestrator:
         # observation) attaches one; its counters then ride every
         # metrics row via _recovery_stats.
         self.autopilot = None
+        #: Optional WeightRolloutCoordinator for a serving fleet (see
+        #: :meth:`attach_serving_rollout`) — attached after
+        #: construction, so the version-0 broadcast below never rolls.
+        self.serving_rollout = None
         self._broadcast_weights()  # version 0: initial policy
         self._rng = jax.random.key(trainer.cfg.seed + 7919)
 
@@ -315,6 +319,29 @@ class AsyncOrchestrator:
                         "weight_sync_retry", a))
         else:
             _sync()
+        if self.serving_rollout is not None:
+            with self._weights_lock:
+                snap = self._rollout_params
+            self._stage_serving_roll(snap)
+
+    def attach_serving_rollout(self, coordinator) -> None:
+        """Serve-while-train (PR 20, closing the PR 18 leftover): with
+        a :class:`WeightRolloutCoordinator` attached, every weight
+        sync ALSO stages the fresh snapshot as a blue/green fleet roll
+        for the serving engines behind the gateway — drain, canary,
+        readmit — instead of blind-reloading them mid-decode.  A roll
+        still converging from a previous sync is never interrupted:
+        the push is skipped (recorded as ``serving_roll_busy``) and
+        the next sync stages a fresher snapshot anyway."""
+        self.serving_rollout = coordinator
+
+    def _stage_serving_roll(self, snapshot) -> None:
+        try:
+            self.serving_rollout.begin(snapshot, self._version)
+            self._event("serving_roll", self._version)
+        except RuntimeError:
+            # Previous roll still in flight — skip, never stack.
+            self._event("serving_roll_busy", self._version)
 
     # ------------------------------------------------------------------
     # rollout worker (host thread driving the rollout device group)
@@ -835,6 +862,10 @@ class PoolOrchestrator:
             from orion_tpu.orchestration.autopilot import SLOAutopilot
 
             self.autopilot = SLOAutopilot(ctrl, engine=None, pool=pool)
+        #: Optional WeightRolloutCoordinator for a serving fleet (see
+        #: :meth:`attach_serving_rollout`) — attached after
+        #: construction, so the version-0 broadcast below never rolls.
+        self.serving_rollout = None
         self._version = 0
         self._rng = jax.random.key(trainer.cfg.seed + 7919)
         self._broadcast()  # version 0: initial policy for every joiner
@@ -870,6 +901,28 @@ class PoolOrchestrator:
             # failed send marks that worker dead); the broadcast
             # itself never takes the learner down.
             self.pool.broadcast(snap, self._version)
+            if self.serving_rollout is not None:
+                self._stage_serving_roll(snap)
+
+    def attach_serving_rollout(self, coordinator) -> None:
+        """Serve-while-train (PR 20, closing the PR 18 leftover): with
+        a :class:`WeightRolloutCoordinator` attached, every pool
+        weight fan-out ALSO stages the host snapshot as a blue/green
+        fleet roll for the serving engines behind the gateway — drain,
+        canary, readmit — instead of blind-reloading them mid-decode.
+        A roll still converging from a previous sync is never
+        interrupted: the push is skipped (recorded as
+        ``serving_roll_busy``) and the next sync stages a fresher
+        snapshot anyway."""
+        self.serving_rollout = coordinator
+
+    def _stage_serving_roll(self, snapshot) -> None:
+        try:
+            self.serving_rollout.begin(snapshot, self._version)
+            self._event("serving_roll", self._version)
+        except RuntimeError:
+            # Previous roll still in flight — skip, never stack.
+            self._event("serving_roll_busy", self._version)
 
     # ------------------------------------------------------------------
     # supervised acquisition

@@ -63,13 +63,30 @@ class SignalReader:
     Cumulative counters (``shed_requests`` and the per-tenant
     ``tenant_<t>_requests_shed`` SLO counters) are carried forward
     across ``reset_server_stats()``: a bench window reset mid-flight
-    must not make the controller believe shedding stopped."""
+    must not make the controller believe shedding stopped.
+
+    ``engine`` may also be a LIST of engines (the replicated edge,
+    PR 20): signals merge fleet-wide — queue depth, running count and
+    shed totals sum; page occupancy is global (1 − Σavailable/Σpages);
+    spec-acceptance is the WEAKEST engine's EMA (the one whose verify
+    chunks stop paying first); TTFT p95 is the worst engine's.  Carry
+    slots are namespaced per engine index so a stats reset on one
+    engine never disturbs another's total; a single-engine reader
+    keeps the legacy un-prefixed slot names, so PR 13 behaviour is
+    bit-identical."""
 
     def __init__(self, engine=None, pool=None):
         # engine=None is the pool-learner shape: no serving engine on
         # this side of the process boundary, so only the pool-capacity
         # signals exist and the ladder never has pressure to climb.
-        self.engine = engine
+        if engine is None:
+            engines = []
+        elif isinstance(engine, (list, tuple)):
+            engines = list(engine)
+        else:
+            engines = [engine]
+        self.engines: List = engines
+        self.engine = engines[0] if engines else None
         self.pool = pool
         # name -> [last_raw, carry]; cumulative = carry + raw, and a
         # raw value that DECREASED means the stat was reset, so the
@@ -84,60 +101,87 @@ class SignalReader:
         return slot[1] + raw
 
     def read(self) -> Dict[str, float]:
-        eng = self.engine
         sig = {"queue_depth": 0.0, "running": 0.0,
                "page_occupancy": 0.0, "spec_accept": 0.0,
                "shed_total": 0.0, "ttft_p95": 0.0}
-        if eng is not None:
-            sched = eng.sched
-            num_pages = max(1, int(eng.num_pages))
-            # available_pages = free + evictable prefix-cache pages:
-            # cached pages are reclaimable on demand, so counting them
-            # as occupied (free_pages) would pin the occupancy signal
-            # near 1.0 forever once the cache warms and the ladder
-            # could never relax.
-            avail = getattr(sched, "available_pages", None)
-            if avail is None:
-                avail = sched.free_pages
-            sig.update({
-                "queue_depth": float(sched.waiting),
-                "running": float(sched.running),
-                "page_occupancy": 1.0 - float(avail) / num_pages,
-                "spec_accept": float(
-                    getattr(eng, "_spec_global_ema", 0.0)),
-                "shed_total": self._cumulative(
-                    "shed_requests", float(eng.shed_requests)),
-            })
-            # Wall-clock signal riding the telemetry histograms; only
-            # consulted when its setpoint is armed (ceiling > 0), so
-            # deterministic default configs never touch it.
-            tele = eng.telemetry
-            sig["ttft_p95"] = float(tele.ttft_s.percentile(95.0))
-            # Per-tenant SLO shed counters, reset-robust — the relax
-            # decision reads these to know whether the clamp is still
-            # absorbing load.
-            for key, ctr in tele.counters().items():
-                if (key.startswith("tenant_")
-                        and key.endswith("_shed")):
-                    sig[key] = self._cumulative(key, float(ctr.value))
+        if self.engines:
+            total_pages = 0
+            total_avail = 0.0
+            accepts: List[float] = []
+            seen_slots = set()
+            for i, eng in enumerate(self.engines):
+                sched = eng.sched
+                total_pages += max(1, int(eng.num_pages))
+                # available_pages = free + evictable prefix-cache
+                # pages: cached pages are reclaimable on demand, so
+                # counting them as occupied (free_pages) would pin the
+                # occupancy signal near 1.0 forever once the cache
+                # warms and the ladder could never relax.
+                avail = getattr(sched, "available_pages", None)
+                if avail is None:
+                    avail = sched.free_pages
+                total_avail += float(avail)
+                sig["queue_depth"] += float(sched.waiting)
+                sig["running"] += float(sched.running)
+                ema = float(getattr(eng, "_spec_global_ema", 0.0))
+                if ema > 0:
+                    accepts.append(ema)
+                # Carry slots namespaced per engine index (engine 0
+                # keeps the legacy un-prefixed names): a bench reset
+                # on one engine rolls into ITS carry only.
+                pfx = "" if i == 0 else f"eng{i}:"
+                sig["shed_total"] += self._cumulative(
+                    pfx + "shed_requests", float(eng.shed_requests))
+                # Wall-clock signal riding the telemetry histograms;
+                # only consulted when its setpoint is armed
+                # (ceiling > 0), so deterministic default configs
+                # never touch it.  Fleet-wide: the WORST engine's p95
+                # is the one the SLO sees.
+                tele = eng.telemetry
+                sig["ttft_p95"] = max(
+                    sig["ttft_p95"],
+                    float(tele.ttft_s.percentile(95.0)))
+                # Per-tenant SLO shed counters, reset-robust — the
+                # relax decision reads these to know whether the
+                # clamp is still absorbing load.
+                for key, ctr in tele.counters().items():
+                    if (key.startswith("tenant_")
+                            and key.endswith("_shed")):
+                        slot = pfx + key
+                        seen_slots.add(slot)
+                        sig[key] = (sig.get(key, 0.0)
+                                    + self._cumulative(
+                                        slot, float(ctr.value)))
             # A reset drops per-tenant counters from the readout
             # entirely (not just to zero) — fold the last raw value
             # into the carry and keep reporting the total, so the
             # tenant's next recorded shed continues from it.
-            for key, slot in self._cum.items():
-                if key.startswith("tenant_") and key not in sig:
-                    slot[1] += slot[0]
-                    slot[0] = 0.0
-                    sig[key] = slot[1]
+            for slot, sl in self._cum.items():
+                base = slot.partition(":")[2] if ":" in slot else slot
+                if base.startswith("tenant_") and slot not in seen_slots:
+                    sl[1] += sl[0]
+                    sl[0] = 0.0
+                    sig[base] = sig.get(base, 0.0) + sl[1]
+            sig["page_occupancy"] = (
+                1.0 - total_avail / max(1, total_pages))
+            # The WEAKEST engine's acceptance EMA: if any engine's
+            # verify chunks stopped paying, the micro-controller
+            # should see it (engines with no spec evidence yet are
+            # excluded, matching the single-engine ema<=0 guard).
+            sig["spec_accept"] = min(accepts) if accepts else 0.0
         if self.pool is not None:
             sig["workers"] = float(len(self.pool.live_members()))
         return sig
 
 
 class SLOAutopilot:
-    """The controller.  One instance per serving engine; drive it from
-    any pump loop via :meth:`maybe_tick` (wall-clock cadence) or
-    :meth:`tick` (explicit, deterministic).
+    """The controller.  One instance per serving engine — or per
+    engine FLEET behind the replicated edge (PR 20): pass ``engine``
+    a list and the reader merges fleet-wide signals while every
+    actuation (setpoints, QoS shed clamps) fans out to each engine,
+    so one ladder governs the whole edge.  Drive it from any pump
+    loop via :meth:`maybe_tick` (wall-clock cadence) or :meth:`tick`
+    (explicit, deterministic).
 
     ``spawn_fn`` / ``retire_fn`` are the elastic-capacity actuators:
     spawn one worker process / retire one.  Both optional — without
@@ -149,8 +193,13 @@ class SLOAutopilot:
                  retire_fn: Optional[Callable[[], object]] = None,
                  clock=time.monotonic):
         self.cfg = cfg
-        self.engine = engine
         self.reader = SignalReader(engine, pool)
+        #: The engine fleet (possibly a singleton); ``self.engine``
+        #: stays the primary — baselines are captured from it and the
+        #: decision log records its setpoint values (the fleet is
+        #: launched homogeneous).
+        self.engines = self.reader.engines
+        self.engine = self.reader.engine
         self.pool = pool
         self.spawn_fn = spawn_fn
         self.retire_fn = retire_fn
@@ -385,6 +434,10 @@ class SLOAutopilot:
         if not kw or self.engine is None:
             return {}
         changed = self.engine.apply_setpoints(**kw)
+        # Fan the same setpoints out to the rest of the fleet; the
+        # decision log records the primary's (old, new) pairs.
+        for eng in self.engines[1:]:
+            eng.apply_setpoints(**kw)
         if changed:
             self.counters_["autopilot_setpoint_changes"] += len(changed)
             self.decisions.append(
@@ -411,25 +464,34 @@ class SLOAutopilot:
                 "max_queued": qos["max_queued"],
                 "max_running": qos["max_running"],
             }
-            eng.configure_tenant(
-                name, weight=qos["weight"],
-                rate_limit=(c.shed_rate_limit if c.shed_rate_limit > 0
-                            else qos["rate_limit"]),
-                # min() so an envelope ALREADY tighter than the shed
-                # clamp stays tight (0 means unlimited, hence the or).
-                max_queued=min(qos["max_queued"] or c.shed_max_queued,
-                               c.shed_max_queued),
-                max_running=min(qos["max_running"] or c.shed_max_running,
-                                c.shed_max_running))
+            # The clamp (computed from the primary's envelope — the
+            # fleet is launched homogeneous) applies to EVERY engine:
+            # a shed that only throttled one engine would just push
+            # the flood to its siblings.
+            for e in self.engines:
+                e.configure_tenant(
+                    name, weight=qos["weight"],
+                    rate_limit=(c.shed_rate_limit
+                                if c.shed_rate_limit > 0
+                                else qos["rate_limit"]),
+                    # min() so an envelope ALREADY tighter than the
+                    # shed clamp stays tight (0 means unlimited,
+                    # hence the or).
+                    max_queued=min(
+                        qos["max_queued"] or c.shed_max_queued,
+                        c.shed_max_queued),
+                    max_running=min(
+                        qos["max_running"] or c.shed_max_running,
+                        c.shed_max_running))
             clamped.append(name)
         self.counters_["autopilot_sheds"] += 1
         self.decisions.append((self.ticks, "shed", tuple(clamped)))
 
     def _leave_shed(self) -> None:
-        eng = self.engine
         restored = []
         for name, env in sorted(self._saved_qos.items()):
-            eng.configure_tenant(name, **env)
+            for e in self.engines:
+                e.configure_tenant(name, **env)
             restored.append(name)
         self._saved_qos.clear()
         self.counters_["autopilot_relaxes"] += 1

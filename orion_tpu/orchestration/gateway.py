@@ -32,16 +32,31 @@ and sends STREAM frames from the engine's token callbacks.  All
 shared gateway state is guarded by ``self._lock`` (lock-discipline
 rule), and every thread registers with the Watchdog like the worker
 pool's.
+
+Replicated edge (PR 20): N gateways may front the SAME engine fleet
+by sharing an :class:`~orion_tpu.orchestration.replica.EdgeCoordinator`
+(``edge=`` argument).  Replicas heartbeat each other over peer ORTP
+links (protocol v8, ``FRAME_REPLICA_HB``), push the live edge set to
+clients (``FRAME_EDGE``), and keep engines single-owner: only the
+lowest live replica's pump touches engines — the others forward
+engine-mutating ops through the edge.  Routing is prefix-affine (the
+prefix cache's chain-hash keys a rendezvous choice of engine, so warm
+prefixes land on the engine holding their pages), and
+:class:`GatewayClient` fails over to a surviving replica on socket
+death, re-submitting in-flight requests idempotently (the edge's
+request-id dedupe replays a completed-but-unacked final verbatim).
 """
 
 from __future__ import annotations
 
 import dataclasses
+import itertools
 import logging
 import pickle
 import queue
 import threading
 import time
+import zlib
 from typing import Any, Dict, Optional
 
 import numpy as np
@@ -51,7 +66,11 @@ from orion_tpu.orchestration.remote import (FRAME_GOODBYE, FRAME_HELLO,
                                             PROTOCOL_VERSION,
                                             ProtocolError, PyTreeChannel,
                                             listen_socket)
+from orion_tpu.orchestration.replica import (FRAME_EDGE, FRAME_REPLICA_HB,
+                                             ReplicaLink,
+                                             rendezvous_engine)
 from orion_tpu.resilience import Watchdog
+from orion_tpu.resilience.inject import InjectedFault, fault_point
 from orion_tpu.rollout.continuous import (CompletedRequest,
                                           EngineOverloaded, StreamChunk)
 
@@ -67,7 +86,8 @@ FRAME_CANCEL = 18   # client → gateway: abort an in-flight request
 _FRAME_NAMES = {
     FRAME_HELLO: "HELLO", FRAME_GOODBYE: "GOODBYE",
     FRAME_SUBMIT: "SUBMIT", FRAME_STREAM: "STREAM",
-    FRAME_CANCEL: "CANCEL",
+    FRAME_CANCEL: "CANCEL", FRAME_REPLICA_HB: "REPLICA_HB",
+    FRAME_EDGE: "EDGE",
 }
 
 
@@ -159,7 +179,7 @@ class ServingGateway:
                  tenants: Optional[Dict[str, dict]] = None,
                  recv_deadline: float = 0.0, tracer=None,
                  idle_wait: float = 0.002, autopilot=None,
-                 prefill_tier=None):
+                 prefill_tier=None, edge=None, affinity: bool = True):
         # Fleet front door (PR 18): ``engine`` may be one engine or a
         # sequence.  Requests route to the least-loaded ADMITTING
         # engine; the rollout coordinator gates engines out via
@@ -167,13 +187,31 @@ class ServingGateway:
         # gateway routes around them so observed availability never
         # drops.  ``self.engine`` stays the primary (autopilot signals,
         # prefill tier, single-engine callers unchanged).
+        #
+        # Replicated edge (PR 20): pass a shared EdgeCoordinator as
+        # ``edge`` and this gateway becomes one replica of it —
+        # engines come FROM the edge, admission/rollout state is
+        # fleet-shared, and only the owning replica's pump steps
+        # engines.  ``affinity`` arms prefix-affine routing (multi-
+        # engine fleets only; falls back to least-pending).
+        self.edge = edge
+        if edge is not None:
+            engine = edge.engines
         self.engines = (list(engine) if isinstance(engine, (list, tuple))
                         else [engine])
         self.engine = self.engines[0]
         self._admit_ok = [True] * len(self.engines)
+        self._affinity = bool(affinity)
+        #: Routing decision log, primitive tuples ``(creq, affine_idx
+        #: or -1, chosen_idx)`` in submit order — the witness the
+        #: affinity-determinism test compares across seeded runs.
+        #: Owner-pump-thread only; bounded.
+        self.route_log: list = []
         #: WeightRolloutCoordinator attaches itself here; the pump
-        #: drives its ticks (single engine-owner thread).
-        self.rollout = None
+        #: drives its ticks (single engine-owner thread).  With an
+        #: edge this is a write-through to ``edge.rollout`` so the
+        #: roll survives the attaching replica's death.
+        self._rollout = None
         self.host = host
         self._tracer = tracer
         self._idle_wait = idle_wait
@@ -209,7 +247,9 @@ class ServingGateway:
         self._stop = threading.Event()
         self._pump_thread: Optional[threading.Thread] = None
         self.stats = {"submits": 0, "sheds": 0, "cancels": 0,
-                      "clients_joined": 0, "clients_left": 0}
+                      "clients_joined": 0, "clients_left": 0,
+                      "resumes": 0, "dedupe_hits": 0,
+                      "affinity_hits": 0, "affinity_misses": 0}
 
         self._srv = listen_socket(port, host=host)
         self.port = self._srv.getsockname()[1]
@@ -218,6 +258,32 @@ class ServingGateway:
             target=self._accept_loop, args=(accept_hb,),
             name="gw-accept", daemon=True)
         self._accept_thread.start()
+
+        # Join the edge LAST (port is bound, accept loop is up): dial
+        # a peer link to every already-live replica — they hold the
+        # accepted end — and start beating.
+        self.replica_id = -1
+        self._links: Dict[int, ReplicaLink] = {}
+        if edge is not None:
+            self.replica_id = edge.register(self)
+            self._edge_seen = edge.version
+            self._next_hb = 0.0
+            for rid, gw_port in edge.live_ports():
+                if rid != self.replica_id:
+                    self._connect_link(rid, gw_port)
+
+    # -- fleet-shared rollout attach point -------------------------------
+    @property
+    def rollout(self):
+        return self.edge.rollout if self.edge is not None else \
+            self._rollout
+
+    @rollout.setter
+    def rollout(self, value) -> None:
+        if self.edge is not None:
+            self.edge.rollout = value
+        else:
+            self._rollout = value
 
     # -- membership ------------------------------------------------------
     def _accept_loop(self, hb) -> None:
@@ -264,15 +330,25 @@ class ServingGateway:
         if kind != FRAME_HELLO:
             raise ProtocolError(
                 f"expected HELLO, got {_FRAME_NAMES.get(kind, kind)}")
+        if str(hello.get("role", "client")) == "replica":
+            # Peer gateway replica dialling its membership link — a
+            # different admission path entirely (no tenant, no client
+            # record, just the liveness channel).
+            self._admit_replica(chan, hello)
+            return
         chan.set_recv_deadline(self.recv_deadline)
         tenant = str(hello.get("tenant", "default"))
         with self._lock:
             cid = self._next_cid
             self._next_cid += 1
         name = str(hello.get("name", f"client-{cid}"))
-        chan.send_frame(FRAME_HELLO,
-                        {"cid": cid, "protocol": PROTOCOL_VERSION,
-                         "tenant": tenant})
+        ack = {"cid": cid, "protocol": PROTOCOL_VERSION,
+               "tenant": tenant}
+        if self.edge is not None:
+            # The client learns the live edge set at admission (and on
+            # every change via FRAME_EDGE) — the failover target list.
+            ack["edge"] = self.edge.live_ports()
+        chan.send_frame(FRAME_HELLO, ack)
         hb = self.watchdog.register(f"gw-client-{cid}", timeout=0.0)
         client = _Client(cid, name, tenant, chan, hb)
         thread = threading.Thread(
@@ -298,6 +374,75 @@ class ServingGateway:
             obs.instant("gw.client-join", cid=cid, tenant=tenant)
         _LOG.info("gateway admitted %s (tenant=%s) as cid=%d",
                   name, tenant, cid)
+
+    # -- replica membership links (PR 20) --------------------------------
+    def _connect_link(self, rid: int, gw_port: int) -> None:
+        """Dial the membership link to an already-live peer replica
+        (constructor context; the peer's accept loop is up)."""
+        chan = PyTreeChannel.connect(
+            gw_port, host=self.host, timeout=10.0,
+            recv_deadline=self.edge.link_deadline, tracer=self._tracer)
+        chan.send_frame(FRAME_HELLO,
+                        {"role": "replica",
+                         "replica_id": self.replica_id,
+                         "port": self.port,
+                         "protocol": PROTOCOL_VERSION})
+        kind, ack = chan.recv_frame()
+        if kind != FRAME_HELLO:
+            chan.close()
+            raise ProtocolError(
+                f"expected replica HELLO ack, got "
+                f"{_FRAME_NAMES.get(kind, kind)}")
+        self._start_link(ReplicaLink(rid, chan))
+
+    def _admit_replica(self, chan, hello: dict) -> None:
+        """Accepted end of a peer's membership link."""
+        if self.edge is None:
+            raise ProtocolError(
+                "replica HELLO at a gateway with no edge attached")
+        peer = int(hello["replica_id"])
+        chan.set_recv_deadline(self.edge.link_deadline)
+        chan.send_frame(FRAME_HELLO,
+                        {"replica_id": self.replica_id,
+                         "protocol": PROTOCOL_VERSION})
+        self._start_link(ReplicaLink(peer, chan))
+        if obs.get_tracer().enabled:
+            obs.instant("gw.replica-join", rid=peer,
+                        at=self.replica_id)
+
+    def _start_link(self, link: ReplicaLink) -> None:
+        with self._lock:
+            self._links[link.rid] = link
+        hb = self.watchdog.register(
+            f"gw{self.replica_id}-link-{link.rid}", timeout=0.0)
+        threading.Thread(
+            target=self._link_recv_loop, args=(link, hb),
+            name=f"gw{self.replica_id}-link-{link.rid}",
+            daemon=True).start()
+
+    def _link_recv_loop(self, link: ReplicaLink, hb) -> None:
+        """One thread per peer link: count beats, watch for death.
+        Link death IS the failure detector — a dead socket, a recv
+        deadline (frozen peer) or a GOODBYE all become a replica-down
+        op for the pump."""
+        try:
+            while not self._stop.is_set() and link.alive:
+                hb.beat()
+                kind, payload = link.chan.recv_frame()
+                if kind == FRAME_REPLICA_HB:
+                    link.beats_seen += 1
+                elif kind == FRAME_GOODBYE:
+                    self._ops.put(("replica-down", None, link.rid))
+                    return
+                else:
+                    raise ProtocolError(
+                        f"unexpected {_FRAME_NAMES.get(kind, kind)} "
+                        "frame on a replica membership link")
+        except (ConnectionError, TimeoutError, OSError, EOFError,
+                pickle.UnpicklingError, ProtocolError):
+            self._ops.put(("replica-down", None, link.rid))
+        finally:
+            self.watchdog.unregister(hb.name)
 
     def _recv_loop(self, client: _Client) -> None:
         """One thread per client: parse frames, enqueue ops.  The pump
@@ -351,43 +496,89 @@ class ServingGateway:
             payload["policy_logprobs"] = comp.policy_logprobs
             with self._lock:
                 self._live.pop(client.reqs.pop(creq, None), None)
+            if self.edge is not None:
+                # Retain the final BEFORE attempting the send: if the
+                # send fails (client mid-failover) the resume replays
+                # this exact payload instead of re-executing.
+                self.edge.record_done((client.name, creq), payload)
         self._send_stream(client, payload)
 
     # -- fleet routing (PR 18) -------------------------------------------
     def set_engine_admit(self, idx: int, ok: bool) -> None:
         """Admission gate for one engine of the fleet: a gated engine
         receives no NEW submits (in-flight decoding continues).  The
-        rollout coordinator's DRAINING/READMIT actuator."""
+        rollout coordinator's DRAINING/READMIT actuator.  With an
+        edge the gate is FLEET-SHARED: gating through any one replica
+        gates the engine at every replica — a weight roll coordinates
+        admission across the whole edge for free."""
+        if self.edge is not None:
+            self.edge.set_admit(idx, ok)
+            return
         with self._lock:
             self._admit_ok[idx] = bool(ok)
 
     def engine_admitting(self, idx: int) -> bool:
+        if self.edge is not None:
+            return self.edge.admitting(idx)
         with self._lock:
             return self._admit_ok[idx]
 
     def _route_order(self, exclude: Optional[int] = None) -> list:
         """Admitting engine indices, least-pending first (ties by
         index — deterministic under seeded replay)."""
-        with self._lock:
-            ok = list(self._admit_ok)
+        if self.edge is not None:
+            ok = self.edge.admit_snapshot()
+        else:
+            with self._lock:
+                ok = list(self._admit_ok)
         return sorted(
             (i for i in range(len(self.engines))
              if ok[i] and i != exclude),
             key=lambda i: (self.engines[i].pending, i))
 
+    def _affine_engine(self, p: dict) -> Optional[int]:
+        """Prefix-affinity key → engine index, or None (affinity off,
+        single engine, prompt shorter than one page, prefix cache
+        disabled, or an injected ``gateway.route`` fault).  The key is
+        the FIRST page's chain-hash — exactly the hash the prefix
+        cache keys its pages by — so every request sharing a template
+        prefix maps to the SAME engine, the one holding the warm
+        pages.  Fail-open: a routing fault degrades to least-pending,
+        never to a dropped request."""
+        if not self._affinity or len(self.engines) < 2:
+            return None
+        try:
+            fault_point("gateway.route")
+            hashes = self.engine._page_hashes(
+                np.asarray(p["ids"], np.int32))
+        except InjectedFault:
+            return None
+        if not hashes:
+            return None
+        return rendezvous_engine(hashes[0], len(self.engines))
+
     def _submit_routed(self, client: _Client, creq: int, rid: int,
                        p: dict, exclude: Optional[int] = None) -> None:
-        """Submit ``p`` on the first admitting engine that accepts it
-        (least-pending first).  A shed from EVERY admitting engine —
-        or an empty route (whole fleet gated) — propagates as the
-        typed EngineOverloaded; a ValueError (malformed request) is
-        the client's own and is never retried on a sibling."""
+        """Submit ``p`` on the first admitting engine that accepts it.
+        Prefix-affine first — the rendezvous-chosen engine leads the
+        order unless it is gated, excluded, or draining — then least-
+        pending: an overload shed from the affine engine falls
+        through to the siblings, so affinity never costs availability.
+        A shed from EVERY admitting engine — or an empty route (whole
+        fleet gated) — propagates as the typed EngineOverloaded; a
+        ValueError (malformed request) is the client's own and is
+        never retried on a sibling."""
         order = self._route_order(exclude=exclude)
         if not order:
             raise EngineOverloaded(
                 "no engine admitting (fleet draining)",
                 queue_depth=sum(e.pending for e in self.engines),
                 retry_after=0.25, tenant=client.tenant)
+        aff = self._affine_engine(p)
+        if aff is not None and aff in order \
+                and not self.engines[aff].draining:
+            order.remove(aff)
+            order.insert(0, aff)
         last: Optional[EngineOverloaded] = None
         for idx in order:
             try:
@@ -406,11 +597,81 @@ class ServingGateway:
                 client.reqs[creq] = rid
                 self._live[rid] = {"client": client, "creq": creq,
                                    "eng": idx, "p": p}
+                if aff is not None:
+                    self.stats["affinity_hits" if idx == aff
+                               else "affinity_misses"] += 1
+            self.route_log.append(
+                (int(creq), -1 if aff is None else int(aff), int(idx)))
+            if len(self.route_log) > 8192:
+                del self.route_log[:4096]
+            if self.edge is not None:
+                self.edge.mark_inflight((client.name, creq),
+                                        self.replica_id, idx, rid)
             return
         raise last
 
+    def _alloc_rid(self) -> int:
+        """Engine request id for a new submit.  With an edge the id
+        comes from the fleet-shared counter — N replicas submit to
+        the SAME engines, so per-gateway counters would collide on
+        the engine's request-id space."""
+        if self.edge is not None:
+            return self.edge.alloc_req_id()
+        with self._lock:
+            rid = self._next_rid
+            self._next_rid += 1
+        return rid
+
+    def _apply_resume(self, client: _Client, creq: int) -> bool:
+        """Idempotent failover re-submit (``resume`` flag on SUBMIT).
+        Returns True when fully handled — the request had COMPLETED on
+        the engine before the client's old replica died, so the
+        retained final frame replays verbatim: bit-identical tokens,
+        no re-execution, no double-billing.  Otherwise any engine-side
+        leftover of the old attempt is cancelled, a RESTARTED marker
+        voids the client's partial delivery, and the caller falls
+        through to a fresh routed submit."""
+        key = (client.name, creq)
+        rec = self.edge.lookup(key)
+        if rec is not None and rec.get("done"):
+            with self._lock:
+                self.stats["dedupe_hits"] += 1
+            # Replay the retained final as ONE restarted full-stream
+            # frame: chunks the dead replica never delivered would
+            # leave a gap in the client's incremental stream, so the
+            # RESTARTED marker voids its partials and ``tokens``
+            # carries the COMPLETE list — bit-identical to the
+            # original completion, engine never re-executed.
+            payload = rec["payload"]
+            self._send_stream(client, {
+                **payload, "tokens": payload["final_tokens"],
+                "restarted": True})
+            return True
+        if rec is not None:
+            # Still in flight from the old connection: take it over.
+            if self.prefill_tier is not None:
+                self.prefill_tier.cancel(rec["rid"])
+            try:
+                self.engines[rec["eng"]].cancel(rec["rid"])
+            except (KeyError, ValueError):
+                pass
+            gw = self.edge.replica(rec["replica"])
+            if gw is not None:
+                with gw._lock:
+                    gw._live.pop(rec["rid"], None)
+            self.edge.forget(key)
+        with self._lock:
+            self.stats["resumes"] += 1
+        self._send_stream(client, {
+            "req": creq, "tokens": np.empty(0, np.int32),
+            "done": False, "restarted": True})
+        return False
+
     def _apply_submit(self, client: _Client, p: dict) -> None:
         creq = int(p["req"])
+        if self.edge is not None and p.get("resume") \
+                and self._apply_resume(client, creq):
+            return
         with self._lock:
             duplicate = creq in client.reqs
         if duplicate:
@@ -419,9 +680,7 @@ class ServingGateway:
                 "error": "bad-request",
                 "message": f"request id {creq} already in flight"})
             return
-        with self._lock:
-            rid = self._next_rid
-            self._next_rid += 1
+        rid = self._alloc_rid()
         if self.prefill_tier is not None and self.engine_admitting(0):
             # Tier route (primary engine only — the tier's KV lands in
             # engine 0's cache): the request is live from the client's
@@ -435,6 +694,9 @@ class ServingGateway:
                 self._live[rid] = {"client": client, "creq": creq,
                                    "eng": 0, "p": p}
                 self.stats["submits"] += 1
+            if self.edge is not None:
+                self.edge.mark_inflight((client.name, creq),
+                                        self.replica_id, 0, rid)
             self.prefill_tier.submit(
                 rid, np.asarray(p["ids"], np.int32),
                 budget=p.get("budget"),
@@ -477,6 +739,8 @@ class ServingGateway:
         client, creq = entry["client"], entry["creq"]
         with self._lock:
             client.reqs.pop(creq, None)
+        if self.edge is not None:
+            self.edge.forget((client.name, creq))
         if isinstance(exc, EngineOverloaded):
             with self._lock:
                 self.stats["sheds"] += 1
@@ -512,6 +776,8 @@ class ServingGateway:
             self._live.pop(rid, None)
             client.reqs.pop(creq, None)
             self.stats["cancels"] += 1
+        if self.edge is not None:
+            self.edge.forget((client.name, creq))
         self._send_stream(client, {
             "req": creq, "done": True, "tokens": np.empty(0, np.int32),
             "error": "cancelled", "message": "cancelled by client"})
@@ -521,13 +787,19 @@ class ServingGateway:
             if not client.alive:
                 return
             client.alive = False
-            rids = list(client.reqs.values())
+            gone = list(client.reqs.items())  # (creq, rid)
             client.reqs.clear()
             reap = []
-            for rid in rids:
+            for _creq, rid in gone:
                 entry = self._live.pop(rid, None)
                 reap.append((rid, entry["eng"] if entry else 0))
             self.stats["clients_left"] += 1
+        if self.edge is not None:
+            # Forget the IN-FLIGHT dedupe records (the work is about
+            # to be reaped); retained DONE records stay — a failover
+            # reconnect of this same logical client replays them.
+            for creq, _rid in gone:
+                self.edge.forget((client.name, creq))
         self.watchdog.unregister(client.hb.name)
         if reap:
             # Deferred to the next pump iteration: this method can run
@@ -555,8 +827,17 @@ class ServingGateway:
         delivered so far, exactly like a preemption restart), and
         resubmit the retained payload on a sibling engine.  The client
         request never drops: it either readmits elsewhere or gets the
-        normal typed overloaded/bad-request error.  Returns how many
-        requests moved."""
+        normal typed overloaded/bad-request error.  With an edge this
+        sweeps EVERY live replica's in-flight set (the rollout
+        coordinator calls through one gateway but the whole edge has
+        requests on the draining engine).  Returns how many requests
+        moved."""
+        if self.edge is not None:
+            return sum(gw._migrate_local(idx)
+                       for gw in self.edge.live_replicas())
+        return self._migrate_local(idx)
+
+    def _migrate_local(self, idx: int) -> int:
         with self._lock:
             victims = [(rid, dict(e)) for rid, e in self._live.items()
                        if e["eng"] == idx]
@@ -577,9 +858,7 @@ class ServingGateway:
             self._send_stream(client, {
                 "req": creq, "tokens": np.empty(0, np.int32),
                 "done": False, "restarted": True})
-            with self._lock:
-                new_rid = self._next_rid
-                self._next_rid += 1
+            new_rid = self._alloc_rid()
             try:
                 self._submit_routed(client, creq, new_rid, p,
                                     exclude=idx)
@@ -587,6 +866,8 @@ class ServingGateway:
             except EngineOverloaded as e:
                 with self._lock:
                     self.stats["sheds"] += 1
+                if self.edge is not None:
+                    self.edge.forget((client.name, creq))
                 self._send_stream(client, {
                     "req": creq, "done": True,
                     "tokens": np.empty(0, np.int32),
@@ -600,33 +881,275 @@ class ServingGateway:
                     "error": "bad-request", "message": str(e)})
         return moved
 
+    # -- edge membership duties (every replica's pump) -------------------
+    def _edge_maintenance(self) -> None:
+        """Heartbeat the peer links (wall-gated cadence — liveness is
+        inherently wall-time; every membership DECISION is driven by
+        link death / GOODBYE / injected faults, which is what keeps
+        seeded replay bit-identical) and push FRAME_EDGE to clients
+        when the live set changed.  A failed or injected beat IS the
+        failure detector firing: the link drops and the peer is
+        presumed dead — the shared edge then demotes it rather than
+        split-braining (see replica.py)."""
+        edge = self.edge
+        now = edge.clock()
+        if now >= self._next_hb:
+            self._next_hb = now + edge.hb_interval
+            with self._lock:
+                links = list(self._links.items())
+            for rid, link in links:
+                if not link.alive:
+                    continue
+                try:
+                    fault_point("replica.heartbeat")
+                    link.chan.send_frame(
+                        FRAME_REPLICA_HB,
+                        {"rid": self.replica_id,
+                         "owner": edge.owner_id()})
+                except (InjectedFault, ConnectionError, TimeoutError,
+                        OSError):
+                    self._replica_down(rid)
+        ver = edge.version
+        if ver != self._edge_seen:
+            self._edge_seen = ver
+            payload = {"edge": edge.live_ports()}
+            with self._lock:
+                clients = [c for c in self._clients.values() if c.alive]
+            for c in clients:
+                try:
+                    c.chan.send_frame(FRAME_EDGE, payload)
+                except (ConnectionError, TimeoutError, OSError):
+                    self._drop_client(c)
+
+    def _replica_down(self, rid: int) -> None:
+        if rid == self.replica_id:
+            return
+        with self._lock:
+            link = self._links.pop(rid, None)
+        if link is not None:
+            link.alive = False
+            try:
+                link.chan.close()
+            except OSError:
+                pass
+        # A link death is SYMMETRIC: both ends observe it and each
+        # presumes the other dead.  The shared edge serializes the
+        # argument — first accusation wins; a replica the membership
+        # already demoted lost it, and its counter-accusation is
+        # discarded (otherwise one dropped link would take BOTH
+        # replicas out and strand the engines ownerless).
+        if not self.edge.is_live(self.replica_id):
+            return
+        if self.edge.peer_down(rid):
+            _LOG.warning("gateway replica %d presumed dead "
+                         "(observed by replica %d)", rid,
+                         self.replica_id)
+            if obs.get_tracer().enabled:
+                obs.instant("gw.replica-down", rid=rid,
+                            by=self.replica_id,
+                            owner=self.edge.owner_id())
+
+    def _adopt_dead(self, dead_rid: int) -> None:
+        """Owner-pump duty after a replica death: cancel the dead
+        replica's engine-side work (its clients are failing over and
+        will re-submit through a survivor — the resume path replays
+        completed finals and re-runs the rest) and forget its
+        in-flight dedupe records so those resumes take the fresh
+        path."""
+        gw = self.edge.replica(dead_rid)
+        if gw is None or gw is self:
+            return
+        with gw._lock:
+            victims = list(gw._live.items())
+            gw._live.clear()
+            for c in gw._clients.values():
+                c.reqs.clear()
+                c.alive = False
+        reaps = [(rid, entry["eng"]) for rid, entry in victims]
+        forget = [(entry["client"].name, entry["creq"])
+                  for _rid, entry in victims]
+        # Reap ops parked in the dead pump's queue (a client drop it
+        # never got to apply) would otherwise leak decoding forever.
+        while True:
+            try:
+                op, _client, payload = gw._ops.get_nowait()
+            except queue.Empty:
+                break
+            if op == "reap":
+                reaps.extend(payload)
+        for rid, eng in sorted(reaps):
+            if self.prefill_tier is not None:
+                self.prefill_tier.cancel(rid)
+            try:
+                self.engines[eng].cancel(rid)
+            except (KeyError, ValueError):
+                pass
+        for key in forget:
+            self.edge.forget(key)
+        if obs.get_tracer().enabled:
+            obs.instant("gw.replica-adopt", rid=dead_rid,
+                        by=self.replica_id, reaped=len(reaps))
+
+    def _fence(self) -> None:
+        """The membership presumed THIS replica dead — a peer won the
+        link-death accusation race, or our own heartbeats stopped
+        landing — while we are in fact still running.  The owner is
+        concurrently adopting our engine-side work, so continuing to
+        serve would hand our clients silent drops (their completions
+        now fan out through nobody).  Fence instead: GOODBYE + close
+        every client channel (they fail over to a live replica and
+        resume idempotently), drop the peer links, stop the pump.
+        Engines are never touched from here — they belong to the
+        owner."""
+        if self._stop.is_set():
+            return
+        _LOG.warning("gateway replica %d fenced (membership presumed "
+                     "it dead); dropping clients for failover",
+                     self.replica_id)
+        self._stop.set()
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+        with self._lock:
+            clients = list(self._clients.values())
+            self._clients.clear()
+            links = list(self._links.values())
+            self._links.clear()
+        for c in clients:
+            # NOT _drop_client: adoption may already have flagged the
+            # client dead gateway-side, but its socket is still open —
+            # the GOODBYE is what turns a silent hang into a failover.
+            c.alive = False
+            try:
+                c.chan.send_frame(FRAME_GOODBYE,
+                                  {"reason": "replica fenced"})
+            except (ConnectionError, TimeoutError, OSError):
+                pass
+            try:
+                c.chan.close()
+            except OSError:
+                pass
+            self.watchdog.unregister(c.hb.name)
+        for link in links:
+            link.alive = False
+            try:
+                link.chan.close()
+            except OSError:
+                pass
+        if obs.get_tracer().enabled:
+            obs.instant("gw.replica-fenced", rid=self.replica_id)
+
+    def kill(self) -> None:
+        """Chaos actuator: simulated SIGKILL of this replica.  Stops
+        the pump and accept loops and closes EVERY socket abruptly —
+        no GOODBYEs, no reaping, no edge departure.  Survivor
+        replicas detect the death through their membership links (and
+        adopt the orphaned engine work); clients see the socket die
+        and fail over.  In-process limitation: the pump thread
+        finishes its current iteration before the join (a real
+        SIGKILL would also take the engines down — here they are the
+        shared fleet and survive, which is the scenario under test:
+        losing the EDGE, not the fleet)."""
+        self._stop.set()
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+        if self._pump_thread is not None:
+            self._pump_thread.join(timeout=5.0)
+            self._pump_thread = None
+        with self._lock:
+            clients = list(self._clients.values())
+            links = list(self._links.values())
+        for c in clients:
+            c.alive = False
+            try:
+                c.chan.close()
+            except OSError:
+                pass
+        for link in links:
+            link.alive = False
+            try:
+                link.chan.close()
+            except OSError:
+                pass
+        if self._accept_thread.is_alive():
+            self._accept_thread.join(timeout=2.0)
+
+    def _is_owner(self) -> bool:
+        """Engine-owner check: without an edge this gateway IS the
+        owner; with one, ownership follows the lowest live replica id
+        (transferring automatically when the owner dies)."""
+        return self.edge is None or \
+            self.edge.owner_id() == self.replica_id
+
+    def _apply_op(self, op, client, payload, owner: bool) -> None:
+        """Apply one queued op.  A NON-owner replica forwards every
+        engine-mutating op to the owner's pump through the edge
+        (engines stay single-owner); client-local ops (leave) and
+        membership ops apply anywhere."""
+        if not owner and op in ("submit", "cancel", "reap"):
+            self.edge.fleet_ops.put((op, client, payload, self))
+            return
+        if op == "submit":
+            self._apply_submit(client, payload)
+        elif op == "cancel":
+            self._apply_cancel(client, payload)
+        elif op == "leave":
+            self._drop_client(client)
+        elif op == "replica-down":
+            self._replica_down(payload)
+        elif op == "reap":
+            # Engine-side aborts for a client dropped mid-wave —
+            # applied here, OUTSIDE any engine.step().
+            for rid, eng in payload:
+                try:
+                    self.engines[eng].cancel(rid)
+                except (KeyError, ValueError):
+                    pass
+        else:  # pragma: no cover - internal op enum
+            raise RuntimeError(f"unknown gateway op {op!r}")
+
     def step(self) -> int:
         """One pump iteration: apply queued client ops, tick the
         rollout coordinator (if attached), run one wave on every
         engine with work, fan out the resulting stream chunks (each
         engine fires the callbacks inside ``step()``).  Returns the
-        number of requests still in flight fleet-wide."""
+        number of requests still in flight fleet-wide.
+
+        With an edge, a NON-owner replica only pumps its clients
+        (forwarding engine ops to the owner) and its membership
+        duties; the owner additionally adopts dead replicas' work,
+        drains the fleet op queue, and runs the engines."""
+        owner = self._is_owner()
         while True:
             try:
                 op, client, payload = self._ops.get_nowait()
             except queue.Empty:
                 break
-            if op == "submit":
-                self._apply_submit(client, payload)
-            elif op == "cancel":
-                self._apply_cancel(client, payload)
-            elif op == "leave":
-                self._drop_client(client)
-            elif op == "reap":
-                # Engine-side aborts for a client dropped mid-wave —
-                # applied here, OUTSIDE any engine.step().
-                for rid, eng in payload:
-                    try:
-                        self.engines[eng].cancel(rid)
-                    except (KeyError, ValueError):
-                        pass
-            else:  # pragma: no cover - internal op enum
-                raise RuntimeError(f"unknown gateway op {op!r}")
+            self._apply_op(op, client, payload, owner)
+        if self.edge is not None:
+            self._edge_maintenance()
+            if self.replica_id >= 0 \
+                    and not self.edge.is_live(self.replica_id):
+                self._fence()
+                return 0
+            if not owner:
+                return 0
+            # Owner-only edge duties, ordered: first adopt any dead
+            # replica's orphaned engine work (cancels free the pages
+            # the resumes below re-claim), then apply ops forwarded
+            # by the other replicas.
+            for dead_rid in self.edge.take_reaps():
+                self._adopt_dead(dead_rid)
+            while True:
+                try:
+                    op, client, payload, gw = \
+                        self.edge.fleet_ops.get_nowait()
+                except queue.Empty:
+                    break
+                gw._apply_op(op, client, payload, True)
         if self.prefill_tier is not None:
             # EDF-admit every request whose prefilled KV arrived (or
             # cold-admit everything if the tier died) BEFORE the wave,
@@ -693,7 +1216,11 @@ class ServingGateway:
         work: once the pump is joined this thread owns the engine, so
         the reap ops _drop_client enqueues are applied here instead of
         rotting in the queue (a caller re-fronting the engine must not
-        inherit cancelled clients' decoding)."""
+        inherit cancelled clients' decoding).  An edge replica leaves
+        GRACEFULLY: GOODBYE on every peer link, then departs the
+        membership — and if it is NOT the engine owner, its leftover
+        reaps are forwarded to the owner instead of touching engines
+        from this thread."""
         self._stop.set()
         try:
             self._srv.close()
@@ -704,22 +1231,41 @@ class ServingGateway:
             self._pump_thread = None
         with self._lock:
             clients = list(self._clients.values())
+            links = list(self._links.values())
+        for link in links:
+            link.alive = False
+            try:
+                link.chan.send_frame(FRAME_GOODBYE,
+                                     {"reason": "shutdown"})
+            except (ConnectionError, TimeoutError, OSError):
+                pass
+            try:
+                link.chan.close()
+            except OSError:
+                pass
         for c in clients:
             self._drop_client(c, goodbye=True)
         # Drain leftover ops (reaps from the drops above, plus
         # anything the pump never got to).  Submits are NOT applied —
         # their clients are gone.
+        owner = self._is_owner()
         while True:
             try:
                 op, _client, payload = self._ops.get_nowait()
             except queue.Empty:
                 break
             if op == "reap":
+                if not owner:
+                    self.edge.fleet_ops.put(("reap", None, payload,
+                                             self))
+                    continue
                 for rid, eng in payload:
                     try:
                         self.engines[eng].cancel(rid)
                     except (KeyError, ValueError):
                         pass
+        if self.edge is not None:
+            self.edge.leave(self.replica_id)
         if self._accept_thread.is_alive():
             self._accept_thread.join(timeout=2.0)
 
@@ -732,41 +1278,96 @@ class GatewayClient:
     ``next_event`` blocks up to ``timeout``; an
     :class:`EngineOverloaded` shed arrives as an event whose ``error``
     IS that typed exception (depth + retry-after preserved), so a
-    remote client backs off exactly like an in-process caller."""
+    remote client backs off exactly like an in-process caller.
+
+    Failover (PR 20): against a replicated edge the HELLO ack (and
+    every FRAME_EDGE push) carries the live replica set.  When the
+    connection dies — replica SIGKILL, drain GOODBYE — the client
+    reconnects to the next live replica under seeded-jitter backoff
+    and re-submits its in-flight requests with the ``resume`` flag:
+    the edge's dedupe replays an already-completed final verbatim and
+    restarts the rest via the RESTARTED-marker machinery, so the
+    caller's event stream just continues.  ``failover=False`` (or an
+    empty survivor set) restores the raise-``GatewayClosed``
+    behavior."""
+
+    #: Per-process default-name counter: dedupe keys are
+    #: ``(client name, request id)`` at the edge, so two anonymous
+    #: clients in one process must not collide.
+    _NAME_SEQ = itertools.count()
 
     def __init__(self, port: int, host: str = "localhost",
                  tenant: str = "default", name: Optional[str] = None,
                  connect_timeout: float = 30.0,
-                 recv_deadline: float = 0.0, tracer=None):
+                 recv_deadline: float = 0.0, tracer=None,
+                 failover: bool = True):
         import os as _os
 
         self.tenant = str(tenant)
-        self.name = name or f"gw-client-{_os.getpid()}"
+        self.name = name or (f"gw-client-{_os.getpid()}-"
+                             f"{next(self._NAME_SEQ)}")
         self.closed = threading.Event()
         self._events: queue.Queue = queue.Queue()
         self._next_req = 0
         self.watchdog = Watchdog()
-        self.chan = PyTreeChannel.connect(
-            port, host=host, timeout=connect_timeout,
-            recv_deadline=recv_deadline, tracer=tracer)
-        self.chan.send_frame(FRAME_HELLO,
-                             {"name": self.name, "tenant": self.tenant,
-                              "protocol": PROTOCOL_VERSION})
-        kind, ack = self.chan.recv_frame()
+        self._host = host
+        self._connect_timeout = connect_timeout
+        self._recv_deadline = recv_deadline
+        self._tracer = tracer
+        self._failover_enabled = bool(failover)
+        self._user_closed = False
+        self.failovers = 0
+        self._inflight: Dict[int, dict] = {}  # creq -> submit payload
+        self._ilock = threading.Lock()
+        self._folock = threading.Lock()
+        #: Serializes event-queue REORDERING (failover's sentinel
+        #: sweep, submit_with_backoff's foreign-event re-queue)
+        #: against the recv thread's puts: stream order is the
+        #: client's only restart-void signal, so a stashed RESTARTED
+        #: marker re-queued behind later chunks would void the wrong
+        #: prefix.
+        self._eqlock = threading.Lock()
+        self._connect(port)
+
+    def _connect(self, port: int) -> None:
+        """Dial + HELLO one replica and start its receive thread.
+        Used by the constructor and by :meth:`_failover` (which
+        replaces ``self.chan`` — the old receive thread notices and
+        exits without poisoning the event queue)."""
+        chan = PyTreeChannel.connect(
+            port, host=self._host, timeout=self._connect_timeout,
+            recv_deadline=self._recv_deadline, tracer=self._tracer)
+        chan.send_frame(FRAME_HELLO,
+                        {"name": self.name, "tenant": self.tenant,
+                         "protocol": PROTOCOL_VERSION})
+        kind, ack = chan.recv_frame()
         if kind == FRAME_GOODBYE:
-            self.chan.close()
+            chan.close()
             raise ConnectionError(
                 f"gateway refused {self.name}: "
                 f"{ack.get('reason', 'no reason given')}")
         if kind != FRAME_HELLO:
-            self.chan.close()
+            chan.close()
             raise ProtocolError(
                 f"expected HELLO ack, got {_FRAME_NAMES.get(kind, kind)}")
+        self.chan = chan
         self.cid = int(ack["cid"])
-        rx_hb = self.watchdog.register(f"gw-client-rx-{self.cid}",
-                                       timeout=0.0)
+        self.port = int(port)
+        #: Live replica ports, rid-ordered — the failover targets.
+        #: A single un-replicated gateway hands back no edge; the
+        #: list then holds just the dialled port.
+        self.edge_ports = [int(p) for _rid, p in ack.get("edge", ())] \
+            or [int(port)]
+        # Re-arm BEFORE the receive thread starts: during a failover
+        # ``closed`` is still set from the old channel's death, and the
+        # recv loop gates on it — a thread that wins the race against a
+        # caller-side clear would exit instantly, leaving the fresh
+        # channel with no reader and the client hung.
+        self.closed.clear()
+        rx_hb = self.watchdog.register(
+            f"gw-client-rx-{self.cid}-{self.failovers}", timeout=0.0)
         self._rx_thread = threading.Thread(
-            target=self._recv_loop, args=(rx_hb,),
+            target=self._recv_loop, args=(rx_hb, chan),
             name="gw-client-recv", daemon=True)
         self._rx_thread.start()
 
@@ -776,17 +1377,27 @@ class GatewayClient:
     #: until ``channel_recv_deadline``) in ``Queue.get``.
     _CLOSED = object()
 
-    def _recv_loop(self, hb) -> None:
+    def _recv_loop(self, hb, chan) -> None:
         reason = "connection lost"
         try:
-            while not self.closed.is_set():
+            while not self.closed.is_set() and chan is self.chan:
                 hb.beat()
-                kind, p = self.chan.recv_frame()
+                kind, p = chan.recv_frame()
                 if kind == FRAME_STREAM:
-                    self._events.put(self._to_event(p))
+                    ev = self._to_event(p)
+                    if ev.done:
+                        # Settled (success OR typed error): no longer
+                        # a failover re-submit candidate.
+                        with self._ilock:
+                            self._inflight.pop(ev.req_id, None)
+                    with self._eqlock:
+                        self._events.put(ev)
+                elif kind == FRAME_EDGE:
+                    self.edge_ports = [int(pt) for _rid, pt in
+                                       p.get("edge", ())] \
+                        or self.edge_ports
                 elif kind == FRAME_GOODBYE:
                     reason = str(p.get("reason", "goodbye"))
-                    self.closed.set()
                     break
                 else:
                     raise ProtocolError(
@@ -795,9 +1406,15 @@ class GatewayClient:
         except (ConnectionError, TimeoutError, OSError, EOFError,
                 pickle.UnpicklingError) as e:
             reason = repr(e)
+        finally:
+            self.watchdog.unregister(hb.name)
+        if chan is self.chan:
+            # Still the active channel (not replaced by a completed
+            # failover): surface the close.  A superseded thread exits
+            # silently — its sentinel would poison the fresh stream.
+            self._close_reason = reason
             self.closed.set()
-        self._close_reason = reason
-        self._events.put(self._CLOSED)
+            self._events.put(self._CLOSED)
 
     @staticmethod
     def _to_event(p: dict) -> StreamEvent:
@@ -825,6 +1442,77 @@ class GatewayClient:
             restarted=bool(p.get("restarted", False)),
             error=error, completed=completed)
 
+    # -- failover --------------------------------------------------------
+    def _failover(self) -> None:
+        """Reconnect to a surviving replica and resume: rotate
+        through the known edge set under seeded-jitter backoff (the
+        per-client seed desynchronizes a thundering herd of orphaned
+        clients — no resynchronized reconnect stampede), then
+        re-submit every unsettled request with the ``resume`` flag.
+        Raises :class:`GatewayClosed` when no replica survives.
+        Serialized under ``_folock``: concurrent callers ride the
+        first one's reconnect."""
+        from orion_tpu.resilience import RetryPolicy
+
+        with self._folock:
+            if not self.closed.is_set():
+                return  # another caller already failed us over
+            reason = getattr(self, "_close_reason", "unknown")
+            if self._user_closed or not self._failover_enabled:
+                raise GatewayClosed(
+                    f"gateway connection closed: {reason}")
+            candidates = [p for p in self.edge_ports if p != self.port]
+            if not candidates:
+                raise GatewayClosed(
+                    f"gateway connection closed: {reason} "
+                    "(no surviving replica)")
+            attempt = [0]
+
+            def _dial_next():
+                port = candidates[attempt[0] % len(candidates)]
+                attempt[0] += 1
+                self._connect(port)
+
+            # closed stays set while we dial (submit() keeps failing
+            # typed); _connect clears it only once a replica's HELLO
+            # ack accepted us — before its recv thread starts, so the
+            # thread's ``closed`` gate never sees the stale flag.
+            policy = RetryPolicy(
+                max_attempts=2 * len(candidates) + 2, base_delay=0.05,
+                jitter=0.5, seed=zlib.crc32(self.name.encode()),
+                retry_on=(ConnectionError, TimeoutError, OSError))
+            try:
+                policy.call(_dial_next)
+            except (ConnectionError, TimeoutError, OSError) as e:
+                self._events.put(self._CLOSED)
+                raise GatewayClosed(
+                    f"failover exhausted after {reason}: {e!r}") from e
+            self.failovers += 1
+            # Drop stale close sentinels; every REAL event queued
+            # before the death is preserved in order (under _eqlock:
+            # the new recv thread is already live and must not
+            # interleave fresh events into the middle of the sweep).
+            with self._eqlock:
+                keep = []
+                while True:
+                    try:
+                        ev = self._events.get_nowait()
+                    except queue.Empty:
+                        break
+                    if ev is not self._CLOSED:
+                        keep.append(ev)
+                for ev in keep:
+                    self._events.put(ev)
+            with self._ilock:
+                pending = sorted(self._inflight.items())
+            for creq, payload in pending:
+                self.chan.send_frame(FRAME_SUBMIT,
+                                     {**payload, "req": int(creq),
+                                      "resume": True})
+            if obs.get_tracer().enabled:
+                obs.instant("gw.client-failover", port=self.port,
+                            resumed=len(pending), after=reason)
+
     # -- request surface -------------------------------------------------
     def submit(self, ids, budget: Optional[int] = None,
                priority: int = 0, deadline: Optional[int] = None,
@@ -832,14 +1520,30 @@ class GatewayClient:
         """Fire-and-stream: returns the request id whose StreamEvents
         will arrive via :meth:`next_event`."""
         if self.closed.is_set():
-            raise ConnectionError("gateway connection is closed")
+            if not self._failover_enabled or self._user_closed:
+                raise ConnectionError("gateway connection is closed")
+            self._failover()
         if req_id is None:
             req_id = self._next_req
         self._next_req = max(self._next_req, int(req_id)) + 1
-        self.chan.send_frame(FRAME_SUBMIT, {
-            "req": int(req_id), "ids": np.asarray(ids, np.int32),
-            "budget": budget, "priority": int(priority),
-            "deadline": deadline})
+        payload = {"ids": np.asarray(ids, np.int32),
+                   "budget": budget, "priority": int(priority),
+                   "deadline": deadline}
+        with self._ilock:
+            self._inflight[int(req_id)] = payload
+        try:
+            self.chan.send_frame(FRAME_SUBMIT,
+                                 {**payload, "req": int(req_id)})
+        except (ConnectionError, TimeoutError, OSError):
+            # The replica died under this very send.  The recv thread
+            # flags the close momentarily; failover then re-submits
+            # this request id from _inflight, so it is NOT lost.
+            if not self._failover_enabled or self._user_closed \
+                    or not self.closed.wait(timeout=5.0):
+                with self._ilock:
+                    self._inflight.pop(int(req_id), None)
+                raise
+            self._failover()
         return int(req_id)
 
     def submit_with_backoff(self, ids, budget: Optional[int] = None,
@@ -856,7 +1560,15 @@ class GatewayClient:
         ``(req_id, first_event)`` for the attempt that was admitted;
         raises the final :class:`EngineOverloaded` once the budget is
         exhausted.  Events for OTHER in-flight requests arriving while
-        we wait are re-queued, not dropped."""
+        we wait are re-queued, not dropped.
+
+        Replica-aware (PR 20): a replica death mid-attempt is NOT a
+        failed attempt — the typed :class:`GatewayClosed` is absorbed
+        by failover (rotate to the next live replica under the same
+        seeded-jitter discipline, idempotent re-submit of this very
+        request id), the wait continues on the survivor, and the
+        foreign events stashed before the death are still re-queued.
+        Only an edge with no survivors surfaces ``GatewayClosed``."""
         from orion_tpu.resilience import RetryPolicy
 
         if policy is None:
@@ -886,8 +1598,26 @@ class GatewayClient:
                         raise ev.error
                     return rid, ev
             finally:
-                for s in stash:
-                    self._events.put(s)
+                if stash:
+                    # Re-insert AHEAD of anything that arrived while
+                    # we waited, preserving arrival order: a stashed
+                    # RESTARTED marker re-queued behind later chunks
+                    # would void the wrong prefix of its stream.
+                    # ``_eqlock`` keeps the sweep atomic against the
+                    # recv loop; duck-typed clients that borrow this
+                    # method (pool backoff shims) have no recv thread
+                    # and no lock — a throwaway lock keeps the same
+                    # shape.
+                    with getattr(self, "_eqlock", None) or \
+                            threading.Lock():
+                        later = []
+                        while True:
+                            try:
+                                later.append(self._events.get_nowait())
+                            except queue.Empty:
+                                break
+                        for s in stash + later:
+                            self._events.put(s)
 
         def _sleep(delay: float) -> None:
             # The policy's jittered schedule is the floor; the
@@ -897,17 +1627,23 @@ class GatewayClient:
         return policy.call(_attempt, sleep=_sleep)
 
     def cancel(self, req_id: int) -> None:
+        with self._ilock:
+            self._inflight.pop(int(req_id), None)
         self.chan.send_frame(FRAME_CANCEL, {"req": int(req_id)})
 
     def next_event(self, timeout: Optional[float] = None
                    ) -> Optional[StreamEvent]:
         """The next StreamEvent from any in-flight request, or None on
-        timeout.  Raises :class:`GatewayClosed` (a ConnectionError)
-        once the channel is closed AND the buffered events are drained
-        — including from a ``timeout=None`` block: the recv loop's
-        closing sentinel wakes the wait, so a gateway drain (server
-        preemption GOODBYE) surfaces immediately as the typed error
-        instead of hanging."""
+        timeout.  Against a replicated edge a dead connection is
+        failed over TRANSPARENTLY (reconnect + idempotent re-submit;
+        the stream continues, prior partials voided by the RESTARTED
+        marker).  Raises :class:`GatewayClosed` (a ConnectionError)
+        once the channel is closed with no surviving replica AND the
+        buffered events are drained — including from a
+        ``timeout=None`` block: the recv loop's closing sentinel
+        wakes the wait, so a gateway drain (server preemption
+        GOODBYE) surfaces immediately as the typed error instead of
+        hanging."""
         try:
             ev = self._events.get(timeout=timeout)
         except queue.Empty:
@@ -916,6 +1652,10 @@ class GatewayClient:
                     "gateway connection closed") from None
             return None
         if ev is self._CLOSED:
+            if self._failover_enabled and not self._user_closed \
+                    and any(p != self.port for p in self.edge_ports):
+                self._failover()  # raises GatewayClosed if exhausted
+                return self.next_event(timeout=timeout)
             # Keep the sentinel visible to any other waiter, then
             # surface the typed close.
             self._events.put(self._CLOSED)
@@ -925,6 +1665,7 @@ class GatewayClient:
         return ev
 
     def close(self) -> None:
+        self._user_closed = True
         if not self.closed.is_set():
             try:
                 self.chan.send_frame(FRAME_GOODBYE, {"reason": "done"})

@@ -107,7 +107,14 @@ MAGIC = b"ORTP"
 #: header unchanged, but a v6 worker neither ACKs weights nor
 #: understands a staged snapshot, so a skewed peer must be rejected
 #: at HELLO, not discovered when the commit point times out.
-PROTOCOL_VERSION = 7
+#: v8: the replica-edge membership family (FRAME_REPLICA_HB /
+#: FRAME_EDGE, defined in orchestration/replica.py) joined the
+#: channel — gateway replicas heartbeat each other and push the live
+#: edge set to their clients.  Header unchanged, but a v7 peer
+#: predates replica HELLOs and edge pushes, so a skewed gateway must
+#: be turned away at the handshake, not when the first membership
+#: frame lands on a peer that cannot dispatch it.
+PROTOCOL_VERSION = 8
 
 #: magic(4) + version(u16) + kind(u8) + trace id(u64) + originating
 #: span id(u64) + payload length(u64).  The trace/span ids are 0 when
@@ -128,6 +135,7 @@ _HEADER_HISTORY = {
     5: ">4sHBQQQ",   # PR 12: same header; gateway frame family added
     6: ">4sHBQQQ",   # PR 17: same header; prefill-tier KV family added
     7: ">4sHBQQQ",   # PR 18: same header; WEIGHTS_ACK/commit handshake
+    8: ">4sHBQQQ",   # PR 20: same header; replica-edge membership family
 }
 
 # Frame kinds multiplexed on one channel.

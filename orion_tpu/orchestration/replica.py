@@ -1,0 +1,278 @@
+"""Replica membership + fleet-shared state for the serving edge (PR 20).
+
+Until now every request funnelled through ONE :class:`ServingGateway`
+process — the last unsupervised single point of failure between the
+clients and the engine fleet.  This module is the small coordination
+layer that lets N gateway replicas front the SAME engine fleet:
+
+- :class:`EdgeCoordinator` is the in-process membership + fleet-state
+  authority the replicas share: who is live, which engines admit,
+  which replica owns the engines this instant, the cross-replica op
+  queue, and the request-id dedupe map that makes client failover
+  idempotent.
+- Third frame family on the ORTP channel (protocol v8):
+  ``FRAME_REPLICA_HB`` (replica ↔ replica liveness beats over a
+  peer link dialled exactly like any other gateway connection, HELLO
+  ``role="replica"``) and ``FRAME_EDGE`` (gateway → client push of
+  the live edge set, so a :class:`GatewayClient` always knows where
+  to fail over).
+
+Ownership model (determinism-critical): engines stay SINGLE-OWNER.
+At any instant exactly one live replica — the lowest live replica id
+— is the engine owner; only its pump steps engines, ticks the rollout
+coordinator, and applies engine-mutating ops.  Every other replica
+pumps its own clients but forwards submit/cancel/reap ops through
+``fleet_ops`` to the owner.  When the owner dies, the next-lowest
+live replica inherits the queue and the orphaned work (see
+``ServingGateway._adopt_dead``), so no op and no in-flight request is
+stranded.  Because the coordinator is one shared object, a replica
+presumed dead by a missed heartbeat is *demoted* (its pump keeps
+forwarding, it just never owns engines) rather than split-brained —
+two pumps can never step the same engine.
+
+The dedupe map is the "never double-bill" half of client failover: a
+request that COMPLETED on the engine but whose final frame was never
+acked (replica died between harvest and send) is replayed verbatim
+from the retained final payload on re-submit — bit-identical tokens,
+zero re-execution.
+"""
+
+from __future__ import annotations
+
+import collections
+import hashlib
+import queue
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+# The replica-edge frame family (PROTOCOL_VERSION 8).  Values are
+# disjoint from the pool family (0-7), the serving family (16-18) and
+# the prefill-tier KV family (32-34), so a frame number in a log
+# unambiguously names its family.
+FRAME_REPLICA_HB = 48   # replica → replica: liveness beat + owner view
+FRAME_EDGE = 49         # gateway → client: live edge set changed
+
+
+def rendezvous_engine(key: int, n: int) -> int:
+    """Deterministic rendezvous (highest-random-weight) choice of an
+    engine index for a prefix-affinity key.
+
+    Every replica computes the same map from the same key — no shared
+    routing table, no coordination — and the choice is stable under
+    engine-set size ``n`` (the fleet size is fixed at launch; gated or
+    draining engines are handled by the CALLER falling back to
+    least-pending, keeping the map itself membership-independent so a
+    drain does not reshuffle every other request's affinity).
+
+    blake2b rather than ``hash()``: the builtin is salted per
+    interpreter, and the affinity map must agree across replica
+    processes and across seeded replay runs.
+    """
+    if n <= 1:
+        return 0
+    kb = int(key).to_bytes(8, "little")
+    best, best_score = 0, -1
+    for i in range(n):
+        score = int.from_bytes(
+            hashlib.blake2b(kb + i.to_bytes(4, "little"),
+                            digest_size=8).digest(), "little")
+        if score > best_score:
+            best, best_score = i, score
+    return best
+
+
+class ReplicaLink:
+    """One live peer link (either dialled or accepted): the channel a
+    replica beats over and watches for the peer's death."""
+
+    def __init__(self, rid: int, chan):
+        self.rid = rid
+        self.chan = chan
+        self.alive = True
+        self.beats_seen = 0
+
+
+class EdgeCoordinator:
+    """Shared membership + fleet state for N gateway replicas.
+
+    Construct one, pass it to every :class:`ServingGateway` via the
+    ``edge=`` argument; each gateway registers itself and receives a
+    replica id.  All mutable state lives behind ``self._lock``; no
+    method calls out to a gateway while holding it (gateways take
+    their own ``_lock`` — the lock ORDER is always gateway → edge,
+    never the reverse).
+
+    ``clock`` is injected (wall time only gates heartbeat CADENCE,
+    never a routing or membership decision — liveness transitions are
+    driven by link death / GOODBYE / injected faults, which is what
+    makes the chaos suite's two-run replay bit-identical).
+    """
+
+    def __init__(self, engines, hb_interval: float = 0.25,
+                 link_deadline: float = 5.0, dedupe_cap: int = 4096,
+                 clock=time.monotonic):
+        self.engines = (list(engines)
+                        if isinstance(engines, (list, tuple))
+                        else [engines])
+        self.hb_interval = float(hb_interval)
+        self.link_deadline = float(link_deadline)
+        self.clock = clock
+        self._lock = threading.Lock()
+        self._replicas: Dict[int, object] = {}   # rid -> ServingGateway
+        self._live: set = set()
+        self._next_rid = 0
+        self._next_req_id = 0
+        self._admit_ok: List[bool] = [True] * len(self.engines)
+        #: Engine-mutating ops forwarded by non-owner replicas:
+        #: ``(op, client, payload, originating_gateway)``.  Drained by
+        #: whichever replica owns the engines — the queue OUTLIVES any
+        #: one replica, so ops forwarded just before an owner death
+        #: are inherited, not lost.
+        self.fleet_ops: queue.Queue = queue.Queue()
+        #: Replica ids whose engine-side work awaits adoption by the
+        #: owner (set on every death, drained by the owner's pump).
+        self._pending_reaps: set = set()
+        #: (client_name, client_req_id) -> record.  ``done`` records
+        #: retain the final STREAM payload for verbatim replay;
+        #: in-flight records name the replica/engine/rid so a resume
+        #: can take the request over.
+        self._dedupe: "collections.OrderedDict[Tuple[str, int], dict]" \
+            = collections.OrderedDict()
+        self._dedupe_cap = int(dedupe_cap)
+        #: Bumped on every membership change; each replica's pump
+        #: pushes FRAME_EDGE to its clients when it observes a new
+        #: version.
+        self.version = 0
+        #: Membership decision log, primitive tuples in commit order —
+        #: the reproducibility witness the chaos suite replays.
+        self.log: List[Tuple[str, int]] = []
+        #: WeightRolloutCoordinator attach point (gateways with an
+        #: edge write through to this slot, so a roll survives the
+        #: death of the replica it was started through — whichever
+        #: replica owns the engines ticks it).
+        self.rollout = None
+
+    # -- membership ------------------------------------------------------
+    def register(self, gateway) -> int:
+        with self._lock:
+            rid = self._next_rid
+            self._next_rid += 1
+            self._replicas[rid] = gateway
+            self._live.add(rid)
+            self.version += 1
+            self.log.append(("join", rid))
+        return rid
+
+    def leave(self, rid: int) -> None:
+        """Graceful departure (``close()``): the replica drained its
+        own clients, so no adoption is scheduled."""
+        with self._lock:
+            if rid not in self._live:
+                return
+            self._live.discard(rid)
+            self.version += 1
+            self.log.append(("leave", rid))
+
+    def peer_down(self, rid: int) -> bool:
+        """A replica was observed dead (link death, GOODBYE, or a
+        missed beat via the ``replica.heartbeat`` fault point).
+        Idempotent; returns True on the 1 → 0 transition.  Schedules
+        the dead replica's engine-side work for owner adoption."""
+        with self._lock:
+            if rid not in self._live:
+                return False
+            self._live.discard(rid)
+            self._pending_reaps.add(rid)
+            self.version += 1
+            self.log.append(("down", rid))
+        return True
+
+    def is_live(self, rid: int) -> bool:
+        with self._lock:
+            return rid in self._live
+
+    def owner_id(self) -> int:
+        """The engine owner this instant: the lowest live replica id
+        (-1 when the whole edge is gone)."""
+        with self._lock:
+            return min(self._live) if self._live else -1
+
+    def live_ports(self) -> List[Tuple[int, int]]:
+        """``[(rid, port), ...]`` of the live edge, rid-sorted — the
+        payload of HELLO acks and FRAME_EDGE pushes."""
+        with self._lock:
+            return [(rid, self._replicas[rid].port)
+                    for rid in sorted(self._live)]
+
+    def live_replicas(self) -> list:
+        with self._lock:
+            return [self._replicas[rid] for rid in sorted(self._live)]
+
+    def replica(self, rid: int):
+        with self._lock:
+            return self._replicas.get(rid)
+
+    def alloc_req_id(self) -> int:
+        """Fleet-unique engine request id.  The engines are SHARED:
+        two replicas allocating from private per-gateway counters
+        would collide on the engine's request-id space (a duplicate
+        id is a ``ValueError`` shed to an innocent client), so every
+        replica allocates through this one counter."""
+        with self._lock:
+            rid = self._next_req_id
+            self._next_req_id += 1
+            return rid
+
+    def take_reaps(self) -> List[int]:
+        """Drain the adoption backlog (owner pump only)."""
+        with self._lock:
+            out = sorted(self._pending_reaps)
+            self._pending_reaps.clear()
+        return out
+
+    # -- fleet admission (shared across replicas) ------------------------
+    def set_admit(self, idx: int, ok: bool) -> None:
+        with self._lock:
+            self._admit_ok[idx] = bool(ok)
+
+    def admitting(self, idx: int) -> bool:
+        with self._lock:
+            return self._admit_ok[idx]
+
+    def admit_snapshot(self) -> List[bool]:
+        with self._lock:
+            return list(self._admit_ok)
+
+    # -- idempotent request dedupe ---------------------------------------
+    def mark_inflight(self, key: Tuple[str, int], replica: int,
+                      eng: int, rid: int) -> None:
+        with self._lock:
+            self._dedupe[key] = {"done": False, "replica": replica,
+                                 "eng": eng, "rid": rid}
+            self._dedupe.move_to_end(key)
+            self._evict_locked()
+
+    def record_done(self, key: Tuple[str, int], payload: dict) -> None:
+        """Retain the final STREAM payload: a resume for this key
+        replays it verbatim instead of re-executing — the
+        completed-but-unacked request never double-bills."""
+        with self._lock:
+            self._dedupe[key] = {"done": True, "payload": payload}
+            self._dedupe.move_to_end(key)
+            self._evict_locked()
+
+    def lookup(self, key: Tuple[str, int]) -> Optional[dict]:
+        with self._lock:
+            return self._dedupe.get(key)
+
+    def forget(self, key: Tuple[str, int]) -> None:
+        with self._lock:
+            self._dedupe.pop(key, None)
+
+    def _evict_locked(self) -> None:
+        # Bounded memory under a long-lived edge: oldest records fall
+        # off; a client that waits past the cap to resume re-executes
+        # (correct, just not deduped).
+        while len(self._dedupe) > self._dedupe_cap:
+            self._dedupe.popitem(last=False)

@@ -46,6 +46,16 @@ primitive tuples and ``counters()`` feeds the gateway's ``rollout_*``
 stats.  ``tick()`` is driven from the gateway pump thread (or directly
 by tests), which is the engines' single owner, so the coordinator may
 step a drained engine synchronously for canary probes.
+
+Replicated edge (PR 20): nothing here changes, by construction.  The
+coordinator's actuators all go through the gateway —
+``set_engine_admit`` / ``engine_admitting`` write the EdgeCoordinator's
+FLEET-SHARED admit gate (so a drain entered through one replica gates
+the engine at every replica) and ``migrate_engine_requests`` sweeps
+every live replica's in-flight set.  Attaching via ``gateway.rollout``
+writes through to ``edge.rollout``, so the roll is ticked by whichever
+replica currently owns the engines and survives the death of the
+replica it was started through.
 """
 
 from __future__ import annotations

@@ -49,6 +49,8 @@ FAULT_POINTS = frozenset({
     "weights.push",       # fleet rollout: per-engine param swap (torn push)
     "engine.drain",       # fleet rollout: blue/green drain entry
     "engine.canary",      # fleet rollout: canary probe gate before readmit
+    "replica.heartbeat",  # gateway-replica edge heartbeat send (fires = link drop)
+    "gateway.route",      # prefix-affinity routing decision (fail-open to least-pending)
 })
 
 

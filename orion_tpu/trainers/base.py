@@ -687,9 +687,20 @@ class BaseTrainer:
         out = self.ckpt.restore(
             state_template=self.state,
             critic_template=getattr(self, "critic_state", None))
-        self.state = out["state"]
+        # Orbax-assembled buffers are not safe to feed into multi-device
+        # XLA computations while another thread (the async rollout
+        # worker) is dispatching: on CPU backends this segfaults
+        # natively inside the first device_put/jit that touches them.
+        # A jitted on-device copy re-materialises every leaf as an
+        # XLA-allocated array with the same sharding; a host round-trip
+        # also works but costs a full transfer on real TPUs.
+        _recopy = jax.jit(lambda t: jax.tree_util.tree_map(jnp.copy, t))
+        self.state = _recopy(out["state"])
+        jax.block_until_ready(jax.tree_util.tree_leaves(self.state))
         if "critic_state" in out and out["critic_state"] is not None:
-            self.critic_state = out["critic_state"]
+            self.critic_state = _recopy(out["critic_state"])
+            jax.block_until_ready(
+                jax.tree_util.tree_leaves(self.critic_state))
         extra = out.get("extra") or {}
         self.global_iter = int(extra.get("global_iter", 0))
         if "rng" in extra:

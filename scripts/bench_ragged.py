@@ -740,6 +740,202 @@ def run_weight_rollout_arm(seed):
     }
 
 
+def run_gateway_failover_arm(seed):
+    """Replicated serving edge arm (ISSUE 20): a paid tenant rides a
+    fixed submit-wave trace through a TWO-replica gateway edge over a
+    two-engine tiny fleet — once undisturbed, once with the paid
+    client's replica SIGKILLed mid-trace (plus a free-tenant flood
+    and an injected ``gateway.route`` fault, which must fail open to
+    least-pending).  The client fails over to the survivor and
+    resumes idempotently; the arm ASSERTS zero dropped / zero
+    duplicated paid completions and records the paired paid-TTFT p95
+    ratio in waves (floored at 2 — sub-wave resolution does not
+    exist in this unit; lower is better).  A second paired A/B runs
+    a shared-template trace with prefix-affine routing on vs off and
+    reports the cross-request prefix-cache pages each served — the
+    consolidation win affinity exists for.  Always the tiny CPU
+    shape: this measures the CONTROL PATH, not model throughput."""
+    from orion_tpu.config import ModelConfig, RolloutConfig
+    from orion_tpu.models import Transformer, init_params
+    from orion_tpu.orchestration.gateway import (GatewayClient,
+                                                 ServingGateway)
+    from orion_tpu.orchestration.replica import EdgeCoordinator
+    from orion_tpu.resilience import active_plan, plan_from_spec
+    from orion_tpu.rollout.continuous import ContinuousBatchingEngine
+
+    W, paid_every, flood_per = 40, 2, 2
+    flood = range(8, 24)
+    kill_wave = 12
+
+    mc = ModelConfig.tiny(dtype="float32")
+    model = Transformer(mc)
+    params = init_params(model, jax.random.key(0), mc)
+
+    def mk_engine(rank):
+        eng = ContinuousBatchingEngine(
+            model, mc, RolloutConfig(
+                max_prompt_len=32, max_new_tokens=8, temperature=0.0,
+                max_batch_size=4, page_size=4, segment_len=4),
+            eos_token_id=None, pad_token_id=0)
+        eng.load_weights(params)
+        eng.reset_rng(jax.random.key(17 + rank))
+        eng.configure_tenant("paid", weight=8)
+        eng.configure_tenant("free", weight=1)
+        return eng
+
+    def edge_stack():
+        fleet = [mk_engine(0), mk_engine(1)]
+        edge = EdgeCoordinator(fleet, hb_interval=0.0,
+                               link_deadline=120.0)
+        gws = [ServingGateway(fleet, edge=edge),
+               ServingGateway(fleet, edge=edge)]
+        deadline = time.monotonic() + 30.0
+        while any(len(gw._links) < 1 for gw in gws):
+            if time.monotonic() > deadline:  # orion: ignore[bench-no-block] link-handshake poll, not a timing window
+                raise RuntimeError("replica links never came up")
+            time.sleep(0.002)
+        return fleet, edge, gws
+
+    def trace(kill):
+        fleet, edge, gws = edge_stack()
+        paid = GatewayClient(gws[1].port, tenant="paid",
+                             name=f"bench-paid-{int(kill)}")
+        free = GatewayClient(gws[0].port, tenant="free",
+                             name=f"bench-free-{int(kill)}")
+        rng = np.random.RandomState(seed)
+        frng = np.random.RandomState(seed + 1)
+        submit_wave, ttft, done_counts = {}, {}, {}
+        plan = plan_from_spec("gateway.route:at=3", seed=seed) \
+            if kill else None
+        ctx = active_plan(plan) if plan is not None else None
+        if ctx is not None:
+            ctx.__enter__()
+        try:
+            def drain(cl, wave):
+                while True:
+                    ev = cl.next_event(timeout=0.001)
+                    if ev is None:
+                        return
+                    if cl is paid:
+                        rid = ev.req_id
+                        if ev.tokens.size and rid not in ttft:
+                            ttft[rid] = wave - submit_wave[rid]
+                        if ev.done:
+                            done_counts[rid] = \
+                                done_counts.get(rid, 0) + 1
+
+            def pump(wave):
+                for gw in gws:
+                    if not gw._stop.is_set():
+                        gw.step()
+                drain(paid, wave)
+                drain(free, wave)
+
+            for w in range(W):
+                if kill and w == kill_wave:
+                    gws[1].kill()     # the paid client's replica
+                if w % paid_every == 0:
+                    rid = paid.submit(
+                        rng.randint(1, 40, size=6 + (w % 5))
+                        .astype(np.int32), budget=4)
+                    submit_wave[rid] = w
+                if kill and w in flood:
+                    for _ in range(flood_per):
+                        free.submit(frng.randint(1, 40, size=8)
+                                    .astype(np.int32), budget=8)
+                pump(w)
+            wave = W
+            deadline = time.monotonic() + 120.0
+            while len(done_counts) < len(submit_wave):
+                if time.monotonic() > deadline:  # orion: ignore[bench-no-block] completion-drain poll, not a timing window
+                    raise RuntimeError("gateway trace never drained")
+                pump(wave)
+                wave += 1
+        finally:
+            if ctx is not None:
+                ctx.__exit__(None, None, None)
+            for cl in (paid, free):
+                try:
+                    cl.close()
+                except (ConnectionError, OSError):
+                    pass
+            for gw in reversed(gws):
+                if not gw._stop.is_set():
+                    gw.close()
+        # The acceptance bar rides the bench too: a kill drops and
+        # duplicates NOTHING.
+        assert sorted(done_counts) == sorted(submit_wave), \
+            "paid completions dropped"
+        assert all(n == 1 for n in done_counts.values()), \
+            "paid completions duplicated"
+        stats = {"ttft": [float(ttft[r]) for r in sorted(ttft)],
+                 "failovers": paid.failovers}
+        if plan is not None:
+            stats["fault_events"] = list(plan.events)
+        return stats
+
+    def affinity_ab(affinity):
+        fleet = [mk_engine(0), mk_engine(1)]
+        gw = ServingGateway(fleet, affinity=affinity)
+        cl = GatewayClient(gw.port, tenant="paid",
+                           name=f"bench-aff-{int(affinity)}")
+        rng = np.random.RandomState(seed + 7)
+        template = rng.randint(1, 40, size=4).astype(np.int32)
+        try:
+            rids = [cl.submit(np.concatenate(
+                [template,
+                 rng.randint(1, 40, size=6).astype(np.int32)]),
+                budget=4) for _ in range(16)]
+            done = set()
+            deadline = time.monotonic() + 120.0
+            while len(done) < len(rids):
+                if time.monotonic() > deadline:  # orion: ignore[bench-no-block] completion-drain poll, not a timing window
+                    raise RuntimeError("affinity trace never drained")
+                gw.step()
+                while True:
+                    ev = cl.next_event(timeout=0.001)
+                    if ev is None:
+                        break
+                    if ev.done:
+                        done.add(ev.req_id)
+            return (sum(e.prefix_cached_pages for e in fleet),
+                    gw.stats["affinity_hits"])
+        finally:
+            cl.close()
+            gw.close()
+
+    def p95(xs):
+        xs = sorted(xs)
+        return float(xs[max(0, int(np.ceil(0.95 * len(xs))) - 1)])
+
+    base = trace(False)
+    chaos = trace(True)
+    cached_on, aff_hits = affinity_ab(True)
+    cached_off, _ = affinity_ab(False)
+    assert chaos["failovers"] == 1, chaos
+    return {
+        "gateway_failover_paid_ttft_p95_waves_base": round(
+            p95(base["ttft"]), 4),
+        "gateway_failover_paid_ttft_p95_waves_kill": round(
+            p95(chaos["ttft"]), 4),
+        # quantization floor on BOTH sides, like the rollout arm: a
+        # healthy edge reads ~1.0 — the survivor adopted + resumed
+        # fast enough that paid TTFT never moved — and only a real
+        # regression (lost resume, stuck adoption) grows the ratio
+        "gateway_failover_p95_ratio": round(
+            max(p95(chaos["ttft"]), 2.0)
+            / max(p95(base["ttft"]), 2.0), 4),
+        "gateway_failover_count": chaos["failovers"],
+        "gateway_route_fault_events": len(chaos.get("fault_events",
+                                                    ())),
+        # Affinity A/B: cross-request prefix-cache pages served on the
+        # shared-template trace, affine routing vs least-pending.
+        "gateway_affinity_cached_pages": cached_on,
+        "gateway_affinity_off_cached_pages": cached_off,
+        "gateway_affinity_hits": aff_hits,
+    }
+
+
 def serve_dense(dense, sh, prompts, budgets, arrivals):
     """Static fixed-batch serving: collect arrived requests, and when a
     full batch of B is waiting (or the trace has drained), decode the
@@ -1109,6 +1305,11 @@ def run(sh=None, seed=None, record=True):
     # Zero-downtime fleet weight rollout (ISSUE 18): paid-tenant TTFT
     # through a mid-trace blue/green roll vs uncontended, tiny shape.
     out.update(run_weight_rollout_arm(seed))
+
+    # Replicated serving edge (ISSUE 20): paid-tenant TTFT through a
+    # mid-trace replica SIGKILL + failover vs undisturbed, plus the
+    # prefix-affinity A/B, tiny control-path shape.
+    out.update(run_gateway_failover_arm(seed))
     if record:
         self_path = os.path.join(os.path.dirname(os.path.dirname(
             os.path.abspath(__file__))), "BENCH_SELF.json")
@@ -1120,6 +1321,7 @@ def run(sh=None, seed=None, record=True):
         tier_key = f"ragged_tiered_cache_toks_per_sec_{sh['model']}"
         auto_key = "autopilot_p95_recovery_tiny"
         roll_key = "weight_rollout_p95_ratio_tiny"
+        fail_key = "gateway_failover_p95_ratio_tiny"
         base = {}
         if os.path.exists(self_path):
             with open(self_path) as f:
@@ -1174,6 +1376,15 @@ def run(sh=None, seed=None, record=True):
             # control-path shape, so the key is model-independent.
             base[roll_key] = out["weight_rollout_p95_ratio"]
             changed = True
+        if fail_key not in base:
+            # Replicated-edge failover regression row (ISSUE 20;
+            # lower is better): paid-tenant TTFT p95 ratio in waves
+            # through a mid-trace replica SIGKILL + client failover
+            # vs the undisturbed paired trace (both sides floored at
+            # the 2-wave quantization).  Tiny control-path shape, so
+            # the key is model-independent.
+            base[fail_key] = out["gateway_failover_p95_ratio"]
+            changed = True
         if changed:
             with open(self_path, "w") as f:
                 json.dump(base, f, indent=1)
@@ -1197,6 +1408,9 @@ def run(sh=None, seed=None, record=True):
         out["weight_rollout_vs_baseline"] = \
             round(out["weight_rollout_p95_ratio"] / base[roll_key], 4) \
             if base.get(roll_key) else 1.0
+        out["gateway_failover_vs_baseline"] = \
+            round(out["gateway_failover_p95_ratio"] / base[fail_key],
+                  4) if base.get(fail_key) else 1.0
     print(json.dumps(out))
     return out
 

@@ -1534,6 +1534,45 @@ def test_wire_history_mirrors_protocol_version():
     assert max(_HEADER_HISTORY) == PROTOCOL_VERSION
 
 
+def test_replica_frame_family_needs_loud_else():
+    """The v8 replica membership family (PR 20): a link recv loop
+    dispatching FRAME_REPLICA_HB/FRAME_GOODBYE with a loud else is
+    the shipped shape and passes; dropping the else silently swallows
+    the family's OTHER frame (FRAME_EDGE misrouted onto a membership
+    link) and must be a finding."""
+    head = """
+    import struct
+
+    PROTOCOL_VERSION = 8
+    _HEADER = struct.Struct(">4sHBQQQ")
+    _HEADER_HISTORY = {8: ">4sHBQQQ"}
+    FRAME_GOODBYE = 5
+    FRAME_REPLICA_HB = 48
+    FRAME_EDGE = 49
+    """
+    good = head + """
+    def link_recv(kind):
+        if kind == FRAME_REPLICA_HB:
+            return "beat"
+        elif kind == FRAME_GOODBYE:
+            return "down"
+        else:
+            raise ValueError(f"unexpected frame {kind}")
+    """
+    assert "frame-exhaustive" not in ids_of(run_on(good, "wire.py"))
+
+    bad = head + """
+    def link_recv(kind):
+        if kind == FRAME_REPLICA_HB:
+            return "beat"
+        elif kind == FRAME_GOODBYE:
+            return "down"
+    """
+    hits = [f for f in run_on(bad, "wire.py")
+            if f.rule_id == "frame-exhaustive"]
+    assert hits and any("FRAME_EDGE" in f.message for f in hits)
+
+
 def test_lock_discipline_ignores_foreign_and_constructor_access():
     """__init__ runs before any thread exists and jax/HF config
     objects are not ours — neither may fire."""

@@ -1,0 +1,634 @@
+"""Replicated serving edge chaos suite (ISSUE 20).
+
+N ServingGateway replicas front the SAME engine fleet through a
+shared EdgeCoordinator: membership joins/leaves/demotions land in a
+deterministic decision log, admission gates and the rollout
+coordinator are fleet-shared, prefix-affine routing maps equal
+template prefixes to the same engine on every replica, and a
+GatewayClient whose replica dies fails over to a survivor and
+resumes idempotently — completed-but-unacked finals replay verbatim
+from the edge dedupe map (zero dropped, zero duplicated, zero
+re-executed), the rest restart under the RESTARTED marker.
+
+The bar mirrors test_weight_rollout's: a replica SIGKILL mid-stream
+drops and duplicates ZERO completions, and the seeded
+heartbeat-fault demotion scenario replays bit-identically
+(final tokens + membership log + fault-plan events + route log).
+
+Determinism discipline: ``hb_interval=0.0`` beats every pump step
+(fault-plan hit counts become pump-round arithmetic, not wall
+time), ``link_deadline=120`` keeps a cold-JIT pump stall from
+reading as a replica death, and submits are parked in the target
+replica's op queue before the first pump so every run applies them
+in one batch.
+"""
+
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from orion_tpu.config import ModelConfig, RolloutConfig
+from orion_tpu.models import Transformer, init_params
+from orion_tpu.orchestration.gateway import (GatewayClient, GatewayClosed,
+                                             ServingGateway)
+from orion_tpu.orchestration.replica import (EdgeCoordinator,
+                                             rendezvous_engine)
+from orion_tpu.orchestration.rollout_controller import (
+    WeightRolloutCoordinator)
+from orion_tpu.resilience import FaultPlan, active_plan
+from orion_tpu.rollout.continuous import ContinuousBatchingEngine
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = ModelConfig.tiny(dtype="float32")
+    model = Transformer(cfg)
+    params = init_params(model, jax.random.key(0), cfg)
+    return cfg, model, params
+
+
+def _mk(model, cfg, params, seed=1, **kw):
+    base = dict(max_prompt_len=32, max_new_tokens=8, temperature=0.0,
+                page_size=4, max_batch_size=4)
+    base.update(kw)
+    eng = ContinuousBatchingEngine(model, cfg, RolloutConfig(**base),
+                                   eos_token_id=None, segment_len=4)
+    eng.load_weights(params)
+    eng.reset_rng(jax.random.key(seed))
+    return eng
+
+
+@pytest.fixture(scope="module")
+def fleet(setup):
+    """Two engines shared across tests (compile once); the autouse
+    cleaner below restores base params + un-drains after each test."""
+    cfg, model, params = setup
+    return [_mk(model, cfg, params, seed=1),
+            _mk(model, cfg, params, seed=2)]
+
+
+@pytest.fixture(autouse=True)
+def _clean_fleet(request, setup):
+    yield
+    if "fleet" in request.fixturenames:
+        cfg, model, params = setup
+        for eng in request.getfixturevalue("fleet"):
+            eng.drain(False)
+            while eng.pending:
+                eng.step()
+            eng.reload_weights(params)
+
+
+def _perturb(params, scale=1.001):
+    return jax.tree_util.tree_map(lambda x: x * scale, params)
+
+
+def _edge_stack(fleet, n=2):
+    """A fresh edge + n replicas over the shared fleet, with the
+    deterministic-test cadence (beat every pump step, a link recv
+    deadline far beyond any cold-JIT pump stall)."""
+    edge = EdgeCoordinator(fleet, hb_interval=0.0, link_deadline=120.0)
+    gws = [ServingGateway(fleet, edge=edge) for _ in range(n)]
+    _wait_links(gws)
+    return edge, gws
+
+
+def _wait_links(gws, timeout=30.0):
+    """Block until every replica holds a live link to every other —
+    link handshakes finish on accept threads, and the fault-plan hit
+    arithmetic needs round 1 to beat over the FULL link set."""
+    deadline = time.monotonic() + timeout
+    want = len(gws) - 1
+    while any(len(gw._links) < want for gw in gws):
+        assert time.monotonic() < deadline, "replica links never came up"
+        time.sleep(0.002)
+
+
+def _close_stack(clients, gws, dead=()):
+    for cl in clients:
+        try:
+            cl.close()
+        except (ConnectionError, OSError):
+            pass
+    for gw in reversed(gws):
+        if gw not in dead:
+            gw.close()
+
+
+def _park_submits(gw, cl, prompts, budget=6):
+    """Submit the batch and wait until every SUBMIT op is parked in
+    the replica's queue, so the next pump applies them atomically —
+    run-to-run identical interleaving."""
+    rids = [cl.submit(p, budget=budget) for p in prompts]
+    deadline = time.monotonic() + 30.0
+    while gw._ops.qsize() < len(prompts):
+        assert time.monotonic() < deadline, "submits never reached gw"
+        time.sleep(0.002)
+    return rids
+
+
+def _drain_edge(gws, want, timeout=300.0):
+    """Pump every non-stopped replica round-robin (rid order) while
+    draining every client's events.  ``want`` maps client -> expected
+    rid list.  Returns {client: (chunks, finals, done_counts,
+    restarted)} with test_weight_rollout's reassembly bookkeeping:
+    a RESTARTED marker voids the partial chunk list."""
+    out = {cl: ({}, {}, {}, set()) for cl in want}
+    deadline = time.monotonic() + timeout
+    while any(len(out[cl][1]) < len(rids) for cl, rids in want.items()):
+        assert time.monotonic() < deadline, "edge drain timed out"
+        for gw in gws:
+            if not gw._stop.is_set():
+                gw.step()
+        for cl in want:
+            chunks, finals, done_counts, restarted = out[cl]
+            while True:
+                ev = cl.next_event(timeout=0.005)
+                if ev is None:
+                    break
+                chunks.setdefault(ev.req_id, [])
+                if ev.restarted:
+                    restarted.add(ev.req_id)
+                    chunks[ev.req_id] = []
+                if ev.tokens.size:
+                    chunks[ev.req_id].append(ev.tokens)
+                if ev.done:
+                    done_counts[ev.req_id] = \
+                        done_counts.get(ev.req_id, 0) + 1
+                    finals[ev.req_id] = ev
+    return out
+
+
+def _assert_zero_drop_dupe(rids, result):
+    """Every submitted request exactly one final, chunks reassembling
+    to the final tokens."""
+    chunks, finals, done_counts, _restarted = result
+    assert sorted(finals) == sorted(rids)          # zero dropped
+    assert all(n == 1 for n in done_counts.values())   # zero duplicated
+    for rid in rids:
+        got = np.concatenate(chunks[rid]) if chunks[rid] else \
+            np.empty(0, np.int32)
+        np.testing.assert_array_equal(got, finals[rid].completed.tokens)
+
+
+def _prompts(cfg, n, seed, plen=10):
+    rng = np.random.RandomState(seed)
+    return [rng.randint(1, cfg.vocab_size, plen).astype(np.int32)
+            for _ in range(n)]
+
+
+# -- membership ---------------------------------------------------------
+
+def test_membership_join_leave_and_fleet_shared_state(fleet):
+    """Joins land in rid order, the lowest live rid owns the engines,
+    a graceful close leaves (never demotes), and admission gates +
+    the rollout attach point are fleet-shared: written through any
+    one replica, visible at every other."""
+    edge, (gw0, gw1) = _edge_stack(fleet)
+    try:
+        assert edge.log == [("join", 0), ("join", 1)]
+        assert edge.owner_id() == 0
+        assert [rid for rid, _p in edge.live_ports()] == [0, 1]
+        assert gw0._is_owner() and not gw1._is_owner()
+
+        # Fleet-shared admission: gate engine 0 through the NON-owner.
+        gw1.set_engine_admit(0, False)
+        assert not gw0.engine_admitting(0)
+        assert edge.admit_snapshot() == [False, True]
+        gw1.set_engine_admit(0, True)
+        assert gw0.engine_admitting(0)
+
+        # Fleet-shared rollout slot: attach through gw1, gw0 sees it.
+        co = WeightRolloutCoordinator(gateway=gw1)
+        assert gw0.rollout is co and edge.rollout is co
+
+        gw1.close()
+        assert edge.log[-1] == ("leave", 1)
+        assert edge.owner_id() == 0
+        assert [rid for rid, _p in edge.live_ports()] == [0]
+    finally:
+        gw0.close()
+
+
+def test_client_learns_edge_set(fleet):
+    """The HELLO ack carries the live edge set; joins and leaves push
+    FRAME_EDGE so every connected client tracks its failover
+    candidates."""
+    edge, gws = _edge_stack(fleet)
+    cl = GatewayClient(gws[0].port, tenant="paid", name="edge-watch")
+    third = None
+    try:
+        assert sorted(cl.edge_ports) == \
+            sorted(p for _r, p in edge.live_ports())
+
+        third = ServingGateway(fleet, edge=edge)   # rid 2 joins
+        deadline = time.monotonic() + 30.0
+        while len(cl.edge_ports) != 3:
+            assert time.monotonic() < deadline, "join never reached client"
+            gws[0].step()
+            time.sleep(0.002)
+        assert third.port in cl.edge_ports
+
+        third.close()
+        third = None
+        deadline = time.monotonic() + 30.0
+        while len(cl.edge_ports) != 2:
+            assert time.monotonic() < deadline, "leave never reached client"
+            gws[0].step()
+            time.sleep(0.002)
+    finally:
+        _close_stack([cl], gws + ([third] if third is not None else []))
+
+
+# -- prefix-affine routing ---------------------------------------------
+
+def test_affinity_routing_is_deterministic(fleet, setup):
+    """Same prompt set, two fresh gateways over the same fleet: the
+    routing decision log is identical — the rendezvous map depends
+    only on prompt bytes, never on wall time or arrival jitter."""
+    cfg, _model, _params = setup
+    prompts = _prompts(cfg, 8, seed=7)
+    logs = []
+    for _run in range(2):
+        gw = ServingGateway(fleet)
+        cl = GatewayClient(gw.port, tenant="paid")
+        try:
+            rids = _park_submits(gw, cl, prompts, budget=4)
+            result = _drain_edge([gw], {cl: rids})
+            _assert_zero_drop_dupe(rids, result[cl])
+            assert gw.stats["affinity_hits"] + \
+                gw.stats["affinity_misses"] == len(prompts)
+            logs.append(list(gw.route_log))
+        finally:
+            _close_stack([cl], [gw])
+    assert logs[0] == logs[1]
+    # The affine choice matches the rendezvous map for every prompt.
+    for p, (_creq, aff, _idx) in zip(prompts, logs[0]):
+        key = fleet[0]._page_hashes(p)[0]
+        assert aff == rendezvous_engine(key, len(fleet))
+
+
+def test_affinity_consolidates_shared_template(fleet, setup):
+    """Requests sharing a template first page all land on ONE engine
+    with affinity armed (the one holding the warm prefix pages);
+    with ``affinity=False`` least-pending spreads them across the
+    fleet.  The second affine batch then prefix-hits the cache."""
+    cfg, _model, _params = setup
+    rng = np.random.RandomState(13)
+    template = rng.randint(1, cfg.vocab_size, 4).astype(np.int32)
+
+    def batch():
+        return [np.concatenate([
+            template,
+            rng.randint(1, cfg.vocab_size, 6).astype(np.int32)])
+            for _ in range(4)]
+
+    gw = ServingGateway(fleet)
+    cl = GatewayClient(gw.port, tenant="paid")
+    try:
+        rids = _park_submits(gw, cl, batch(), budget=4)
+        result = _drain_edge([gw], {cl: rids})
+        _assert_zero_drop_dupe(rids, result[cl])
+        engines_used = {idx for _creq, _aff, idx in gw.route_log}
+        assert len(engines_used) == 1
+        assert gw.stats["affinity_hits"] == 4
+
+        # Second shared-template batch: the warm pages pay off.
+        warm = sum(e.prefix_cached_pages for e in fleet)
+        rids2 = _park_submits(gw, cl, batch(), budget=4)
+        result2 = _drain_edge([gw], {cl: rids2})
+        _assert_zero_drop_dupe(rids2, result2[cl])
+        assert sum(e.prefix_cached_pages for e in fleet) > warm
+    finally:
+        _close_stack([cl], [gw])
+
+    # Control arm: affinity off, the same template spreads.
+    gw = ServingGateway(fleet, affinity=False)
+    cl = GatewayClient(gw.port, tenant="paid")
+    try:
+        rids = _park_submits(gw, cl, batch(), budget=4)
+        result = _drain_edge([gw], {cl: rids})
+        _assert_zero_drop_dupe(rids, result[cl])
+        assert all(aff == -1 for _creq, aff, _idx in gw.route_log)
+        assert len({idx for _creq, _aff, idx in gw.route_log}) == \
+            len(fleet)
+    finally:
+        _close_stack([cl], [gw])
+
+
+def test_affinity_falls_back_when_engine_drains(fleet, setup):
+    """The affine engine draining for a weight roll: the request
+    falls through to a sibling (typed shed absorbed inside the
+    gateway, counted as an affinity miss) — affinity never costs
+    availability."""
+    cfg, _model, _params = setup
+    prompt = _prompts(cfg, 1, seed=29)[0]
+    aff = rendezvous_engine(fleet[0]._page_hashes(prompt)[0], len(fleet))
+    gw = ServingGateway(fleet)
+    cl = GatewayClient(gw.port, tenant="paid")
+    fleet[aff].drain(True)
+    try:
+        rids = _park_submits(gw, cl, [prompt], budget=4)
+        result = _drain_edge([gw], {cl: rids})
+        _assert_zero_drop_dupe(rids, result[cl])
+        assert gw.stats["affinity_misses"] == 1
+        assert gw.route_log[-1] == (rids[0], aff, 1 - aff)
+    finally:
+        fleet[aff].drain(False)
+        _close_stack([cl], [gw])
+
+
+def test_route_fault_fails_open_to_least_pending(fleet, setup):
+    """An injected ``gateway.route`` fault degrades the affine lookup
+    to least-pending — the request still completes; the plan replay
+    witnesses exactly one firing."""
+    cfg, _model, _params = setup
+    prompts = _prompts(cfg, 2, seed=31)
+    plan = FaultPlan({"gateway.route": {"at": 1}}, seed=0)
+    gw = ServingGateway(fleet)
+    cl = GatewayClient(gw.port, tenant="paid")
+    try:
+        with active_plan(plan):
+            rids = _park_submits(gw, cl, prompts, budget=4)
+            result = _drain_edge([gw], {cl: rids})
+        _assert_zero_drop_dupe(rids, result[cl])
+        assert plan.events == [("gateway.route", 1)]
+        # First submit lost its affinity key to the fault (aff -1),
+        # the second resolved normally.
+        assert gw.route_log[0][1] == -1
+        assert gw.route_log[1][1] != -1
+    finally:
+        _close_stack([cl], [gw])
+
+
+# -- heartbeat-fault demotion + fencing ---------------------------------
+
+def _heartbeat_demotion_run(fleet, cfg, seed):
+    """The seeded demotion scenario (one witness per run): two
+    replicas, two clients, an injected heartbeat fault on the owner's
+    round-3 beat demotes replica 1 mid-stream.  The demoted replica
+    fences (GOODBYEs its clients), the client fails over to the
+    owner and resumes, and every request completes exactly once.
+
+    Beat arithmetic under hb_interval=0: round 1 beats are hits 1
+    (gw0) and 2 (gw1); round 2 beats are hits 3 and 4 — the round
+    that also routes the forwarded non-owner submits; ``at=5`` is
+    gw0's round-3 beat, so demotion strikes with replica 1's work
+    in flight."""
+    plan = FaultPlan({"replica.heartbeat": {"at": 5}}, seed=seed)
+    edge, (gw0, gw1) = _edge_stack(fleet)
+    cl0 = GatewayClient(gw0.port, tenant="paid", name="hb-owner-side")
+    cl1 = GatewayClient(gw1.port, tenant="paid", name="hb-victim-side")
+    try:
+        with active_plan(plan):
+            prompts = _prompts(cfg, 4, seed=seed)
+            rids0 = _park_submits(gw0, cl0, prompts[:2], budget=6)
+            rids1 = _park_submits(gw1, cl1, prompts[2:], budget=6)
+            results = _drain_edge([gw0, gw1],
+                                  {cl0: rids0, cl1: rids1})
+        _assert_zero_drop_dupe(rids0, results[cl0])
+        _assert_zero_drop_dupe(rids1, results[cl1])
+        assert plan.events == [("replica.heartbeat", 5)]
+        assert edge.log == [("join", 0), ("join", 1), ("down", 1)]
+        assert edge.owner_id() == 0
+        # The demoted replica fenced itself rather than serving a
+        # membership that presumes it dead.
+        assert gw1._stop.is_set()
+        assert cl1.failovers == 1
+        # Replica 1's two requests resumed through the owner.
+        assert gw0.stats["resumes"] + gw0.stats["dedupe_hits"] == 2
+        return {
+            "finals0": {r: results[cl0][1][r].completed.tokens.tolist()
+                        for r in rids0},
+            "finals1": {r: results[cl1][1][r].completed.tokens.tolist()
+                        for r in rids1},
+            "log": list(edge.log),
+            "events": list(plan.events),
+            "routes0": list(gw0.route_log),
+        }
+    finally:
+        _close_stack([cl0, cl1], [gw0, gw1], dead=[gw1])
+
+
+def test_heartbeat_fault_demotes_and_replays_bit_identical(fleet, setup):
+    """Two runs of the seeded demotion scenario produce the SAME
+    witness: final tokens, membership log, fault-plan events and the
+    owner's route log — the acceptance replay bar."""
+    cfg, _model, _params = setup
+    first = _heartbeat_demotion_run(fleet, cfg, seed=11)
+    second = _heartbeat_demotion_run(fleet, cfg, seed=11)
+    assert first == second
+
+
+# -- replica SIGKILL chaos ---------------------------------------------
+
+def _owner_kill_run(fleet, cfg, seed):
+    """SIGKILL the OWNER replica mid-stream: ownership transfers to
+    the survivor, which adopts the orphaned engine work; the client
+    fails over and resumes; zero dropped, zero duplicated."""
+    edge, (gw0, gw1) = _edge_stack(fleet)
+    cl = GatewayClient(gw0.port, tenant="paid", name="kill-victim")
+    try:
+        prompts = _prompts(cfg, 4, seed=seed)
+        rids = _park_submits(gw0, cl, prompts, budget=6)
+        gw0.step()          # admit + first wave (nothing can be done
+        gw1.step()          # yet: budget 6 > one decode segment)
+        assert len(cl._inflight) == len(rids), \
+            "everything must be in flight at kill time"
+        gw0.kill()
+        results = _drain_edge([gw1], {cl: rids})
+        _assert_zero_drop_dupe(rids, results[cl])
+        assert cl.failovers == 1
+        assert ("down", 0) in edge.log
+        assert edge.owner_id() == 1
+        assert gw1.stats["resumes"] + gw1.stats["dedupe_hits"] >= 1
+        return {r: results[cl][1][r].completed.tokens.tolist()
+                for r in rids}, list(edge.log)
+    finally:
+        _close_stack([cl], [gw0, gw1], dead=[gw0])
+
+
+def test_owner_kill_zero_drop_zero_dupe_and_replays(fleet, setup):
+    """The replica-SIGKILL acceptance: a fixed-round kill of the
+    engine-owning replica drops and duplicates nothing, and two
+    seeded runs deliver bit-identical finals and membership logs
+    (the restarted set is wall-clock shaped and excluded)."""
+    cfg, _model, _params = setup
+    finals_a, log_a = _owner_kill_run(fleet, cfg, seed=17)
+    finals_b, log_b = _owner_kill_run(fleet, cfg, seed=17)
+    assert finals_a == finals_b
+    assert log_a == log_b
+
+
+def test_completed_unacked_final_replays_without_reexecution(fleet,
+                                                             setup):
+    """White-box dedupe bar: a request that COMPLETED but whose final
+    was never acked (client died between harvest and ack) replays
+    verbatim from the edge record on resume — bit-identical tokens,
+    restarted marker set, zero engine re-execution, never
+    double-billed."""
+    cfg, _model, _params = setup
+    edge, (gw0, gw1) = _edge_stack(fleet)
+    cl = GatewayClient(gw1.port, tenant="paid", name="unacked")
+    try:
+        prompt = _prompts(cfg, 1, seed=23)[0]
+        rids = _park_submits(gw1, cl, [prompt], budget=4)
+        results = _drain_edge([gw0, gw1], {cl: rids})
+        first = results[cl][1][rids[0]]
+
+        # Re-arm the settled request as if the final never arrived,
+        # then kill the client's replica: failover re-submits it with
+        # the resume flag and the retained record answers.
+        with cl._ilock:
+            cl._inflight[rids[0]] = {
+                "ids": prompt, "budget": 4, "priority": 0,
+                "deadline": None}
+        before_submits = gw0.stats["submits"]
+        before_routes = len(gw0.route_log)
+        gw1.kill()
+        results2 = _drain_edge([gw0], {cl: rids})
+        second = results2[cl][1][rids[0]]
+
+        np.testing.assert_array_equal(second.completed.tokens,
+                                      first.completed.tokens)
+        assert second.restarted
+        assert rids[0] in results2[cl][3]
+        assert gw0.stats["dedupe_hits"] == 1
+        # The replay never touched an engine: no new submit, no new
+        # routing decision.
+        assert gw0.stats["submits"] == before_submits
+        assert len(gw0.route_log) == before_routes
+    finally:
+        _close_stack([cl], [gw0, gw1], dead=[gw1])
+
+
+def test_submit_with_backoff_rotates_replicas(fleet, setup):
+    """satellite: a replica death mid-``submit_with_backoff`` is NOT
+    a failed attempt — the typed close is absorbed by failover to
+    the next live replica, the in-flight request resumes under the
+    same id, and foreign events queued before the death are
+    preserved."""
+    cfg, _model, _params = setup
+    edge, (gw0, gw1) = _edge_stack(fleet)
+    cl = GatewayClient(gw1.port, tenant="paid", name="backoff-rotor")
+    try:
+        prompts = _prompts(cfg, 2, seed=37)
+        rid_a = _park_submits(gw1, cl, prompts[:1], budget=6)[0]
+        # Exactly two pump rounds: round 1 forwards A to the owner,
+        # round 2 routes it and runs wave 1 — 4 of 6 budgeted tokens,
+        # so A is STILL IN FLIGHT at the kill with one chunk parked
+        # client-side (undrained — it must survive the failover as a
+        # foreign event).  Pump-until-event would let a fast box
+        # settle A entirely before the kill and void the scenario.
+        for _ in range(2):
+            gw0.step()
+            gw1.step()
+        deadline = time.monotonic() + 120.0
+        while cl._events.qsize() == 0:     # socket latency only
+            assert time.monotonic() < deadline, "no chunk before kill"
+            time.sleep(0.002)
+        assert rid_a in cl._inflight
+        gw1.kill()
+        gw0.start()      # survivor pumps in the background
+        rid_b, ev_b = cl.submit_with_backoff(prompts[1], budget=6,
+                                             event_timeout=120.0)
+        assert cl.failovers == 1
+        assert ev_b.req_id == rid_b and ev_b.error is None
+        # Drain both streams to their finals on the survivor.  B's
+        # first chunk came back through submit_with_backoff (the
+        # caller owns it), so seed its reassembly with it.
+        results = _drain_edge([], {cl: [rid_a, rid_b]}, timeout=120.0)
+        if ev_b.tokens.size and rid_b not in results[cl][3]:
+            results[cl][0][rid_b].insert(0, ev_b.tokens)
+        _assert_zero_drop_dupe([rid_a, rid_b], results[cl])
+        assert rid_a in results[cl][3]      # A restarted on the survivor
+    finally:
+        _close_stack([cl], [gw0, gw1], dead=[gw1])
+
+
+# -- learner-driven fleet rolls (serve-while-train) ---------------------
+
+def test_pool_weight_sync_stages_fleet_roll(fleet, setup):
+    """satellite: a PoolOrchestrator with a serving rollout
+    coordinator attached stages every weight fan-out as a blue/green
+    fleet roll (recorded as ``serving_roll``); a roll still in
+    flight is skipped (``serving_roll_busy``), never stacked."""
+    from test_trainers import _mk as _mk_cfg, lucky_token_reward
+
+    from orion_tpu.config import GRPOConfig
+    from orion_tpu.orchestration import PoolOrchestrator, WorkerPool
+    from orion_tpu.trainers import GRPOTrainer
+
+    cfg, model, params = setup
+    tcfg = _mk_cfg(GRPOConfig, model=cfg, group_size=2, kl_coef=0.0,
+                   num_epochs=1, async_mode=True, async_staleness=1,
+                   seed=0, minibatch_size=4)
+    trainer = GRPOTrainer(tcfg, model, params,
+                          reward_fn=lucky_token_reward,
+                          eos_token_id=None)
+    pool = WorkerPool(0, heartbeat_timeout=30.0)
+    try:
+        orch = PoolOrchestrator(trainer, pool)
+        co = WeightRolloutCoordinator(engines=fleet)
+        orch.attach_serving_rollout(co)
+        orch._version = 1
+        orch._broadcast()
+        assert ("serving_roll", 1) in orch.events
+        assert co.active
+
+        def _converge():
+            n = 0
+            while co.active:
+                assert n < 500, "rollout did not converge"
+                co.tick()
+                for e in fleet:
+                    if e.pending:
+                        e.step()
+                n += 1
+
+        _converge()
+        assert co.version == 1
+        assert co.counters()["rollout_commits"] == 1
+
+        # Busy path: a roll already converging is never interrupted.
+        co.begin(_perturb(params), version=7)
+        orch._version = 2
+        orch._broadcast()
+        assert ("serving_roll_busy", 2) in orch.events
+        _converge()
+        assert co.version == 7
+    finally:
+        pool.shutdown()
+
+
+# -- fleet-merged autopilot signals ------------------------------------
+
+def test_autopilot_signals_merge_across_fleet(fleet):
+    """satellite: SignalReader over an engine LIST merges fleet-wide
+    — depths and shed totals sum, occupancy is global, TTFT is the
+    worst engine's — and the single-engine readout stays the legacy
+    shape."""
+    from orion_tpu.orchestration.autopilot import SignalReader
+
+    merged = SignalReader(fleet)
+    singles = [SignalReader(e) for e in fleet]
+    assert merged.engines == list(fleet) and merged.engine is fleet[0]
+
+    fleet[0].submit(9001, np.arange(1, 9, dtype=np.int32), budget=4)
+    fleet[1].submit(9002, np.arange(2, 12, dtype=np.int32), budget=4)
+    sig = merged.read()
+    parts = [r.read() for r in singles]
+    assert sig["queue_depth"] == sum(p["queue_depth"] for p in parts)
+    assert sig["running"] == sum(p["running"] for p in parts)
+    assert sig["shed_total"] == sum(p["shed_total"] for p in parts)
+    assert sig["ttft_p95"] == max(p["ttft_p95"] for p in parts)
+    total_pages = sum(max(1, int(e.num_pages)) for e in fleet)
+    avail = sum(float(getattr(e.sched, "available_pages",
+                              e.sched.free_pages)) for e in fleet)
+    assert sig["page_occupancy"] == \
+        pytest.approx(1.0 - avail / max(1, total_pages))
+    for eng in fleet:
+        while eng.pending:
+            eng.step()
